@@ -1,0 +1,107 @@
+"""hashgraph_tpu — a TPU-native hashgraph-style binary consensus framework.
+
+A brand-new JAX/XLA implementation with the capabilities of the reference
+Rust library vacp2p/hashgraph-like-consensus (mounted read-only during
+development; see SURVEY.md): binary yes/no decisions among n peers via signed
+hashgraph vote chains, ceil(2n/3) quorum math, Gossipsub/P2P round semantics,
+silent-peer liveness at timeout, scoped multi-tenant sessions, and pluggable
+storage / event-bus / signature-scheme backends.
+
+The consensus engine state lives as dense per-proposal tensors evaluated by
+vmapped/sharded XLA kernels (hashgraph_tpu.ops / .models / .parallel); vote
+hashing and ECDSA verification run on the host (hashgraph_tpu.signing,
+optionally accelerated by the native C++ runtime). The scalar Python layer in
+this package is the bit-exactness oracle the device kernels are validated
+against.
+"""
+
+from .errors import (
+    ConsensusError,
+    ConsensusFailed,
+    ConsensusNotReached,
+    ConsensusSchemeError,
+    DuplicateVote,
+    EmptySignature,
+    EmptyVoteHash,
+    EmptyVoteOwner,
+    InsufficientVotesAtTimeout,
+    InvalidConsensusThreshold,
+    InvalidExpectedVotersCount,
+    InvalidMaxRounds,
+    InvalidTimeout,
+    InvalidVoteHash,
+    InvalidVoteSignature,
+    InvalidVoteTimestamp,
+    MaxRoundsExceeded,
+    ParentHashMismatch,
+    ProposalAlreadyExist,
+    ProposalExpired,
+    ReceivedHashMismatch,
+    ScopeNotFound,
+    SessionNotActive,
+    SessionNotFound,
+    StatusCode,
+    TimestampOlderThanCreationTime,
+    UserAlreadyVoted,
+    VoteExpired,
+    VoteProposalIdMismatch,
+)
+from .protocol import (
+    build_vote,
+    calculate_consensus_result,
+    compute_vote_hash,
+    has_sufficient_votes,
+    validate_proposal,
+    validate_vote_chain,
+)
+from .signing import (
+    ConsensusSignatureScheme,
+    EthereumConsensusSigner,
+    StubConsensusSigner,
+)
+from .wire import Proposal, Vote
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Proposal",
+    "Vote",
+    "ConsensusSignatureScheme",
+    "EthereumConsensusSigner",
+    "StubConsensusSigner",
+    "build_vote",
+    "compute_vote_hash",
+    "validate_proposal",
+    "validate_vote_chain",
+    "calculate_consensus_result",
+    "has_sufficient_votes",
+    "StatusCode",
+    "ConsensusError",
+    "ConsensusFailed",
+    "ConsensusNotReached",
+    "ConsensusSchemeError",
+    "DuplicateVote",
+    "EmptySignature",
+    "EmptyVoteHash",
+    "EmptyVoteOwner",
+    "InsufficientVotesAtTimeout",
+    "InvalidConsensusThreshold",
+    "InvalidExpectedVotersCount",
+    "InvalidMaxRounds",
+    "InvalidTimeout",
+    "InvalidVoteHash",
+    "InvalidVoteSignature",
+    "InvalidVoteTimestamp",
+    "MaxRoundsExceeded",
+    "ParentHashMismatch",
+    "ProposalAlreadyExist",
+    "ProposalExpired",
+    "ReceivedHashMismatch",
+    "ScopeNotFound",
+    "SessionNotActive",
+    "SessionNotFound",
+    "TimestampOlderThanCreationTime",
+    "UserAlreadyVoted",
+    "VoteExpired",
+    "VoteProposalIdMismatch",
+]

@@ -1,0 +1,317 @@
+"""Scalar protocol kernels: hashing, vote building, validation, consensus math.
+
+This is the host-side *oracle* layer: pure functions that reproduce the
+reference's protocol semantics bit-exactly (reference: src/utils.rs). The
+vectorized JAX kernels in :mod:`hashgraph_tpu.ops` are validated against these
+functions case-by-case, and the integer threshold values shipped to the device
+are computed here (in IEEE-754 double precision, matching Rust f64).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import sys
+import uuid
+from typing import TYPE_CHECKING, Iterable, Mapping
+
+from .errors import (
+    EmptySignature,
+    EmptyVoteHash,
+    EmptyVoteOwner,
+    InvalidConsensusThreshold,
+    InvalidExpectedVotersCount,
+    InvalidTimeout,
+    InvalidVoteHash,
+    InvalidVoteSignature,
+    ParentHashMismatch,
+    ProposalExpired,
+    ReceivedHashMismatch,
+    TimestampOlderThanCreationTime,
+    VoteExpired,
+    VoteProposalIdMismatch,
+)
+from .wire import Proposal, Vote
+
+if TYPE_CHECKING:
+    from .signing import ConsensusSignatureScheme
+
+_U32_MASK = 0xFFFFFFFF
+_U32_MAX = 0xFFFFFFFF
+_F64_EPSILON = sys.float_info.epsilon  # == Rust f64::EPSILON
+_TWO_THIRDS = 2.0 / 3.0
+
+
+def fold_u128_to_u32(n: int) -> int:
+    """Fold a 128-bit value into 32 bits via XOR so every bit contributes
+    (reference: src/utils.rs:19-21)."""
+    return ((n >> 96) ^ (n >> 64) ^ (n >> 32) ^ n) & _U32_MASK
+
+
+def generate_id() -> int:
+    """Generate a unique 32-bit ID from a UUIDv4 (reference: src/utils.rs:27-30)."""
+    return fold_u128_to_u32(uuid.uuid4().int)
+
+
+def compute_vote_hash(vote: Vote) -> bytes:
+    """SHA-256 over the vote's identifying fields in a fixed byte order
+    (reference: src/utils.rs:37-47). The signature field is excluded."""
+    hasher = hashlib.sha256()
+    hasher.update((vote.vote_id & _U32_MASK).to_bytes(4, "little"))
+    hasher.update(vote.vote_owner)
+    hasher.update((vote.proposal_id & _U32_MASK).to_bytes(4, "little"))
+    hasher.update((vote.timestamp & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "little"))
+    hasher.update(bytes([1 if vote.vote else 0]))
+    hasher.update(vote.parent_hash)
+    hasher.update(vote.received_hash)
+    return hasher.digest()
+
+
+def build_vote(
+    proposal: Proposal,
+    user_vote: bool,
+    signer: "ConsensusSignatureScheme",
+    now: int,
+) -> Vote:
+    """Create a new signed vote with hashgraph chain linking.
+
+    ``received_hash`` links to the last vote in the proposal's list;
+    ``parent_hash`` links to this voter's own most recent vote
+    (reference: src/utils.rs:55-98).
+    """
+    voter_identity = signer.identity()
+
+    if proposal.votes:
+        latest_vote = proposal.votes[-1]
+        own_last_vote = next(
+            (v for v in reversed(proposal.votes) if v.vote_owner == voter_identity),
+            None,
+        )
+        if own_last_vote is not None:
+            parent_hash, received_hash = own_last_vote.vote_hash, latest_vote.vote_hash
+        else:
+            parent_hash, received_hash = b"", latest_vote.vote_hash
+    else:
+        parent_hash, received_hash = b"", b""
+
+    vote = Vote(
+        vote_id=generate_id(),
+        vote_owner=bytes(voter_identity),
+        proposal_id=proposal.proposal_id,
+        timestamp=now,
+        vote=user_vote,
+        parent_hash=parent_hash,
+        received_hash=received_hash,
+        vote_hash=b"",
+        signature=b"",
+    )
+    vote.vote_hash = compute_vote_hash(vote)
+    vote.signature = signer.sign(vote.encode())
+    return vote
+
+
+def validate_proposal(proposal: Proposal, scheme, now: int) -> None:
+    """Validate a proposal and all its votes (reference: src/utils.rs:106-120)."""
+    validate_proposal_timestamp(proposal.expiration_timestamp, now)
+    for vote in proposal.votes:
+        if vote.proposal_id != proposal.proposal_id:
+            raise VoteProposalIdMismatch()
+        validate_vote(vote, scheme, proposal.expiration_timestamp, proposal.timestamp, now)
+    validate_vote_chain(proposal.votes)
+
+
+def validate_vote(
+    vote: Vote,
+    scheme,
+    expiration_timestamp: int,
+    creation_time: int,
+    now: int,
+) -> None:
+    """Validate a single vote: structure, hash, signature, replay, expiry.
+
+    Check order matters and mirrors the reference exactly
+    (reference: src/utils.rs:127-171).
+    """
+    if not vote.vote_owner:
+        raise EmptyVoteOwner()
+    if not vote.vote_hash:
+        raise EmptyVoteHash()
+    if not vote.signature:
+        raise EmptySignature()
+
+    expected_hash = compute_vote_hash(vote)
+    if vote.vote_hash != expected_hash:
+        raise InvalidVoteHash()
+
+    if not scheme.verify(vote.vote_owner, vote.signing_payload(), vote.signature):
+        raise InvalidVoteSignature()
+
+    # Replay guard: the vote cannot predate the proposal
+    # (reference: src/utils.rs:160-164).
+    if vote.timestamp < creation_time:
+        raise TimestampOlderThanCreationTime()
+
+    if vote.timestamp > expiration_timestamp or now > expiration_timestamp:
+        raise VoteExpired()
+
+
+def validate_vote_chain(votes: list[Vote]) -> None:
+    """Validate the hashgraph chain structure over an ordered vote list
+    (reference: src/utils.rs:175-215).
+
+    Rules:
+    - a non-empty ``received_hash`` must equal the immediately previous vote's
+      ``vote_hash``, with non-decreasing timestamps;
+    - a non-empty ``parent_hash`` must resolve to an earlier-indexed vote by
+      the same owner with timestamp <= this vote's.
+    """
+    if len(votes) <= 1:
+        return
+
+    hash_index: dict[bytes, tuple[bytes, int, int]] = {}
+    for idx, vote in enumerate(votes):
+        hash_index[vote.vote_hash] = (vote.vote_owner, vote.timestamp, idx)
+
+    for idx, vote in enumerate(votes):
+        if idx > 0 and vote.received_hash:
+            prev_vote = votes[idx - 1]
+            if vote.received_hash != prev_vote.vote_hash:
+                raise ReceivedHashMismatch()
+            if prev_vote.timestamp > vote.timestamp:
+                raise ReceivedHashMismatch()
+
+        if vote.parent_hash:
+            entry = hash_index.get(vote.parent_hash)
+            if entry is None:
+                raise ParentHashMismatch()
+            owner, ts, parent_idx = entry
+            if not (owner == vote.vote_owner and ts <= vote.timestamp and parent_idx < idx):
+                raise ParentHashMismatch()
+
+
+def calculate_consensus_result(
+    votes: Mapping[bytes, Vote] | Iterable[Vote],
+    expected_voters: int,
+    consensus_threshold: float,
+    liveness_criteria_yes: bool,
+    is_timeout: bool,
+) -> bool | None:
+    """THE decision kernel (scalar form). Reference: src/utils.rs:227-286.
+
+    Accepts either an owner->Vote mapping or an iterable of votes (each owner
+    assumed distinct). Returns True (YES), False (NO), or None (undecided).
+    """
+    if isinstance(votes, Mapping):
+        vote_values = [v.vote for v in votes.values()]
+    else:
+        vote_values = [v.vote for v in votes]
+    total_votes = len(vote_values)
+    yes_votes = sum(1 for v in vote_values if v)
+    return decide(
+        yes_votes,
+        total_votes,
+        expected_voters,
+        consensus_threshold,
+        liveness_criteria_yes,
+        is_timeout,
+    )
+
+
+def decide(
+    yes_votes: int,
+    total_votes: int,
+    expected_voters: int,
+    consensus_threshold: float,
+    liveness_criteria_yes: bool,
+    is_timeout: bool,
+) -> bool | None:
+    """Count-level form of the decision kernel — the exact scalar rules the
+    vectorized device kernel must match (reference: src/utils.rs:227-286)."""
+    no_votes = max(total_votes - yes_votes, 0)
+    silent_votes = max(expected_voters - total_votes, 0)
+
+    # n <= 2: unanimity rule (reference: src/utils.rs:239-244).
+    if expected_voters <= 2:
+        if total_votes < expected_voters:
+            return None
+        return yes_votes == expected_voters
+
+    required_votes = calculate_required_votes(expected_voters, consensus_threshold)
+    # At timeout, silent peers count toward quorum (reference: src/utils.rs:249-253).
+    effective_total = expected_voters if is_timeout else total_votes
+    if effective_total < required_votes:
+        return None
+
+    required_choice_votes = calculate_threshold_based_value(
+        expected_voters, consensus_threshold
+    )
+    yes_weight = yes_votes + (silent_votes if liveness_criteria_yes else 0)
+    no_weight = no_votes + (0 if liveness_criteria_yes else silent_votes)
+
+    if yes_weight >= required_choice_votes and yes_weight > no_weight:
+        return True
+    if no_weight >= required_choice_votes and no_weight > yes_weight:
+        return False
+    if total_votes == expected_voters and yes_weight == no_weight:
+        return liveness_criteria_yes
+    return None
+
+
+def calculate_required_votes(expected_voters: int, consensus_threshold: float) -> int:
+    """Minimum participation to potentially reach consensus
+    (reference: src/utils.rs:292-299)."""
+    if expected_voters <= 2:
+        return expected_voters
+    return calculate_threshold_based_value(expected_voters, consensus_threshold)
+
+
+def calculate_max_rounds(expected_voters: int, consensus_threshold: float) -> int:
+    """Dynamic P2P round cap, ceil(2n/3) by default (reference: src/utils.rs:302-304)."""
+    return calculate_threshold_based_value(expected_voters, consensus_threshold)
+
+
+def calculate_threshold_based_value(expected_voters: int, consensus_threshold: float) -> int:
+    """Precision-critical threshold math (reference: src/utils.rs:307-313).
+
+    The default 2/3 threshold takes an exact integer path — ``ceil(2n/3)`` via
+    integer division — to avoid f64 rounding; other thresholds use
+    ``ceil(n * t)`` in f64 (Python floats are IEEE-754 doubles, matching Rust).
+    The final ``as u32`` cast saturates like Rust's.
+    """
+    if abs(consensus_threshold - _TWO_THIRDS) < _F64_EPSILON:
+        return (2 * expected_voters + 2) // 3  # div_ceil(2n, 3)
+    value = math.ceil((expected_voters * 1.0) * consensus_threshold)
+    if value < 0:
+        return 0
+    return min(int(value), _U32_MAX)
+
+
+def validate_proposal_timestamp(expiration_timestamp: int, now: int) -> None:
+    """Reject expired proposals (reference: src/utils.rs:320-328)."""
+    if now >= expiration_timestamp:
+        raise ProposalExpired()
+
+
+def validate_threshold(threshold: float) -> None:
+    """Threshold must be within [0.0, 1.0] (reference: src/utils.rs:331-336)."""
+    if not (0.0 <= threshold <= 1.0):
+        raise InvalidConsensusThreshold()
+
+
+def validate_timeout(timeout_seconds: float) -> None:
+    """Timeout must be > 0 (reference: src/utils.rs:339-344)."""
+    if timeout_seconds <= 0:
+        raise InvalidTimeout()
+
+
+def validate_expected_voters_count(expected_voters_count: int) -> None:
+    """expected_voters_count must be >= 1 (reference: src/utils.rs:347-354)."""
+    if expected_voters_count == 0:
+        raise InvalidExpectedVotersCount()
+
+
+def has_sufficient_votes(
+    total_votes: int, expected_voters: int, consensus_threshold: float
+) -> bool:
+    """Quick participation check (reference: src/utils.rs:360-367)."""
+    return total_votes >= calculate_required_votes(expected_voters, consensus_threshold)

@@ -1,0 +1,51 @@
+"""Pluggable signature schemes for vote authentication.
+
+Mirrors the reference's scheme abstraction (reference: src/signing.rs:46-74):
+a scheme instance carries private state and produces signatures via
+``identity()`` / ``sign()``; the scheme *type* verifies incoming signatures via
+the class-level ``verify()``. All peers on a network must use the same scheme.
+
+Signature verification always runs on the host — ECDSA does not map to the
+MXU — and is batched across worker threads (or the native runtime) by the
+ingest pipeline; only the vote tally/decision state lives on device.
+"""
+
+from __future__ import annotations
+
+import abc
+
+from ..errors import ConsensusSchemeError
+
+__all__ = [
+    "ConsensusSignatureScheme",
+    "ConsensusSchemeError",
+    "EthereumConsensusSigner",
+    "StubConsensusSigner",
+]
+
+
+class ConsensusSignatureScheme(abc.ABC):
+    """A signature scheme the consensus service uses to sign and verify votes
+    (reference: src/signing.rs:46-74)."""
+
+    @abc.abstractmethod
+    def identity(self) -> bytes:
+        """Stable identity bytes for this signer (address / public key / id).
+        Written into ``Vote.vote_owner`` when casting."""
+
+    @abc.abstractmethod
+    def sign(self, payload: bytes) -> bytes:
+        """Sign ``payload`` and return raw signature bytes."""
+
+    @classmethod
+    @abc.abstractmethod
+    def verify(cls, identity: bytes, payload: bytes, signature: bytes) -> bool:
+        """Verify ``signature`` over ``payload`` against ``identity``.
+
+        Returns True/False for well-formed inputs; raises
+        :class:`ConsensusSchemeError` for malformed ones (wrong lengths etc.).
+        """
+
+
+from .ethereum import EthereumConsensusSigner  # noqa: E402
+from .stub import StubConsensusSigner  # noqa: E402

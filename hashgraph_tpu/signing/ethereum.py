@@ -1,0 +1,100 @@
+"""ECDSA-secp256k1 signing scheme with Ethereum conventions.
+
+Matches the reference's default scheme (reference: src/signing/ethereum.rs):
+identity is the 20-byte Ethereum address, signatures are 65-byte recoverable
+``r || s || v`` over the EIP-191 prefixed message, and verification recovers
+the address and compares. Implemented on pure-Python secp256k1 + Keccak so the
+framework has zero non-baked dependencies; the native runtime accelerates bulk
+verification.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+from ..errors import ConsensusSchemeError
+from . import ConsensusSignatureScheme
+from ._keccak import keccak256
+from ._secp256k1 import N, pubkey_from_private, recover_pubkey, sign_recoverable
+
+ETHEREUM_SIGNATURE_LENGTH = 65
+ETHEREUM_ADDRESS_LENGTH = 20
+
+
+def eip191_hash(payload: bytes) -> bytes:
+    """Keccak-256 of the EIP-191 personal-message envelope.
+
+    The reference signs via alloy's ``sign_message_sync`` which applies the
+    same ``"\\x19Ethereum Signed Message:\\n" + len`` prefix
+    (reference: src/signing/ethereum.rs:58-64).
+    """
+    prefix = b"\x19Ethereum Signed Message:\n" + str(len(payload)).encode("ascii")
+    return keccak256(prefix + payload)
+
+
+def address_from_pubkey(pubkey: tuple[int, int]) -> bytes:
+    """Last 20 bytes of keccak256(uncompressed public key sans 0x04 prefix)."""
+    x, y = pubkey
+    return keccak256(x.to_bytes(32, "big") + y.to_bytes(32, "big"))[-20:]
+
+
+class EthereumConsensusSigner(ConsensusSignatureScheme):
+    """Holds a 32-byte private key; identity is the derived 20-byte address."""
+
+    def __init__(self, private_key: bytes | int):
+        if isinstance(private_key, bytes):
+            if len(private_key) != 32:
+                raise ValueError("private key must be 32 bytes")
+            private_key = int.from_bytes(private_key, "big")
+        if not (1 <= private_key < N):
+            raise ValueError("private key out of range for secp256k1")
+        self._private_key = private_key
+        self._address = address_from_pubkey(pubkey_from_private(private_key))
+
+    @classmethod
+    def random(cls) -> "EthereumConsensusSigner":
+        """Generate a fresh random signer (PrivateKeySigner::random equivalent)."""
+        while True:
+            candidate = secrets.randbits(256)
+            if 1 <= candidate < N:
+                return cls(candidate)
+
+    def identity(self) -> bytes:
+        return self._address
+
+    def private_key_bytes(self) -> bytes:
+        """Expose key material for interop/tests (inner() equivalent)."""
+        return self._private_key.to_bytes(32, "big")
+
+    def sign(self, payload: bytes) -> bytes:
+        try:
+            r, s, v = sign_recoverable(eip191_hash(payload), self._private_key)
+        except Exception as exc:  # pragma: no cover - curve math never fails in practice
+            raise ConsensusSchemeError.sign(str(exc)) from exc
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([27 + (v & 1)])
+
+    @classmethod
+    def verify(cls, identity: bytes, payload: bytes, signature: bytes) -> bool:
+        # Length checks raise scheme errors, mirroring the reference
+        # (reference: src/signing/ethereum.rs:71-82).
+        if len(signature) != ETHEREUM_SIGNATURE_LENGTH:
+            raise ConsensusSchemeError.verify(
+                f"expected {ETHEREUM_SIGNATURE_LENGTH}-byte signature, got {len(signature)}"
+            )
+        if len(identity) != ETHEREUM_ADDRESS_LENGTH:
+            raise ConsensusSchemeError.verify(
+                f"expected {ETHEREUM_ADDRESS_LENGTH}-byte address, got {len(identity)}"
+            )
+
+        r = int.from_bytes(signature[0:32], "big")
+        s = int.from_bytes(signature[32:64], "big")
+        v = signature[64]
+        if v >= 27:
+            v -= 27
+        if v > 1:
+            raise ConsensusSchemeError.verify(f"invalid recovery id byte: {signature[64]}")
+
+        pubkey = recover_pubkey(eip191_hash(payload), r, s, v)
+        if pubkey is None:
+            raise ConsensusSchemeError.verify("signature recovery failed")
+        return address_from_pubkey(pubkey) == bytes(identity)

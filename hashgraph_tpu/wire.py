@@ -1,0 +1,294 @@
+"""Wire data model: ``Proposal`` and ``Vote`` messages with a protobuf codec.
+
+Byte-compatible with the reference schema
+(reference: src/protos/messages/v1/consensus.proto:5-29) as encoded by prost:
+proto3 semantics, fields emitted in ascending field-number order, and
+default-valued scalar fields (0 / false / empty) omitted. The vote signature is
+computed over exactly this encoding with the ``signature`` field blanked
+(reference: src/utils.rs:93-97, 150-153), so encoding fidelity is
+load-bearing for cross-implementation signature verification.
+
+The codec is hand-rolled (no generated code) so the framework controls every
+byte; it is a few hundred lines and covers only the two message types the
+protocol uses.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["Vote", "Proposal"]
+
+_U32_MASK = 0xFFFFFFFF
+_U64_MASK = 0xFFFFFFFFFFFFFFFF
+
+# Wire types
+_VARINT = 0
+_LEN = 2
+
+
+def _encode_varint(out: bytearray, value: int) -> None:
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _encode_tag(out: bytearray, field_number: int, wire_type: int) -> None:
+    _encode_varint(out, (field_number << 3) | wire_type)
+
+
+def _encode_uint_field(out: bytearray, field_number: int, value: int) -> None:
+    if value:
+        _encode_tag(out, field_number, _VARINT)
+        _encode_varint(out, value)
+
+
+def _encode_bool_field(out: bytearray, field_number: int, value: bool) -> None:
+    if value:
+        _encode_tag(out, field_number, _VARINT)
+        out.append(1)
+
+
+def _encode_bytes_field(out: bytearray, field_number: int, value: bytes) -> None:
+    if value:
+        _encode_tag(out, field_number, _LEN)
+        _encode_varint(out, len(value))
+        out += value
+
+
+def _decode_varint(data: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _checked_end(data: bytes, pos: int, length: int) -> int:
+    end = pos + length
+    if end > len(data):
+        raise ValueError("truncated length-delimited field")
+    return end
+
+
+def _skip_field(data: bytes, pos: int, wire_type: int) -> int:
+    if wire_type == _VARINT:
+        _, pos = _decode_varint(data, pos)
+        return pos
+    if wire_type == 1:  # fixed64
+        return _checked_end(data, pos, 8)
+    if wire_type == _LEN:
+        length, pos = _decode_varint(data, pos)
+        return _checked_end(data, pos, length)
+    if wire_type == 5:  # fixed32
+        return _checked_end(data, pos, 4)
+    raise ValueError(f"unsupported wire type {wire_type}")
+
+
+@dataclass(slots=True)
+class Vote:
+    """A single vote in a consensus proposal.
+
+    Field numbers match the reference schema
+    (reference: src/protos/messages/v1/consensus.proto:19-29).
+    """
+
+    vote_id: int = 0  # field 20, uint32
+    vote_owner: bytes = b""  # field 21
+    proposal_id: int = 0  # field 22, uint32
+    timestamp: int = 0  # field 23, uint64
+    vote: bool = False  # field 24
+    parent_hash: bytes = b""  # field 25
+    received_hash: bytes = b""  # field 26
+    vote_hash: bytes = b""  # field 27
+    signature: bytes = b""  # field 28
+
+    def _encode_signed_fields(self, out: bytearray) -> None:
+        """Fields 20-27 — everything the signature covers. Shared between
+        ``encode`` and ``signing_payload`` so the signed bytes can never
+        drift from the wire bytes."""
+        _encode_uint_field(out, 20, self.vote_id & _U32_MASK)
+        _encode_bytes_field(out, 21, self.vote_owner)
+        _encode_uint_field(out, 22, self.proposal_id & _U32_MASK)
+        _encode_uint_field(out, 23, self.timestamp & _U64_MASK)
+        _encode_bool_field(out, 24, self.vote)
+        _encode_bytes_field(out, 25, self.parent_hash)
+        _encode_bytes_field(out, 26, self.received_hash)
+        _encode_bytes_field(out, 27, self.vote_hash)
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        self._encode_signed_fields(out)
+        _encode_bytes_field(out, 28, self.signature)
+        return bytes(out)
+
+    def signing_payload(self) -> bytes:
+        """Encoding with the signature field blanked — the bytes that get
+        signed (reference: src/utils.rs:93-95, 150-153)."""
+        out = bytearray()
+        self._encode_signed_fields(out)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Vote":
+        vote = cls()
+        pos = 0
+        n = len(data)
+        while pos < n:
+            key, pos = _decode_varint(data, pos)
+            field_number, wire_type = key >> 3, key & 7
+            if field_number == 20 and wire_type == _VARINT:
+                v, pos = _decode_varint(data, pos)
+                vote.vote_id = v & _U32_MASK
+            elif field_number == 22 and wire_type == _VARINT:
+                v, pos = _decode_varint(data, pos)
+                vote.proposal_id = v & _U32_MASK
+            elif field_number == 23 and wire_type == _VARINT:
+                v, pos = _decode_varint(data, pos)
+                vote.timestamp = v & _U64_MASK
+            elif field_number == 24 and wire_type == _VARINT:
+                v, pos = _decode_varint(data, pos)
+                vote.vote = bool(v)
+            elif wire_type == _LEN and field_number in (21, 25, 26, 27, 28):
+                length, pos = _decode_varint(data, pos)
+                end = _checked_end(data, pos, length)
+                value = data[pos:end]
+                pos = end
+                if field_number == 21:
+                    vote.vote_owner = value
+                elif field_number == 25:
+                    vote.parent_hash = value
+                elif field_number == 26:
+                    vote.received_hash = value
+                elif field_number == 27:
+                    vote.vote_hash = value
+                else:
+                    vote.signature = value
+            else:
+                pos = _skip_field(data, pos, wire_type)
+        return vote
+
+    def clone(self) -> "Vote":
+        return Vote(
+            vote_id=self.vote_id,
+            vote_owner=self.vote_owner,
+            proposal_id=self.proposal_id,
+            timestamp=self.timestamp,
+            vote=self.vote,
+            parent_hash=self.parent_hash,
+            received_hash=self.received_hash,
+            vote_hash=self.vote_hash,
+            signature=self.signature,
+        )
+
+
+@dataclass(slots=True)
+class Proposal:
+    """A consensus proposal that needs voting.
+
+    Field numbers match the reference schema
+    (reference: src/protos/messages/v1/consensus.proto:5-16).
+    """
+
+    name: str = ""  # field 10
+    payload: bytes = b""  # field 11
+    proposal_id: int = 0  # field 12, uint32
+    proposal_owner: bytes = b""  # field 13
+    votes: list[Vote] = field(default_factory=list)  # field 14
+    expected_voters_count: int = 0  # field 15, uint32
+    round: int = 0  # field 16, uint32
+    timestamp: int = 0  # field 17, uint64
+    expiration_timestamp: int = 0  # field 18, uint64
+    liveness_criteria_yes: bool = False  # field 19
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        if self.name:
+            name_bytes = self.name.encode("utf-8")
+            _encode_tag(out, 10, _LEN)
+            _encode_varint(out, len(name_bytes))
+            out += name_bytes
+        _encode_bytes_field(out, 11, self.payload)
+        _encode_uint_field(out, 12, self.proposal_id & _U32_MASK)
+        _encode_bytes_field(out, 13, self.proposal_owner)
+        for vote in self.votes:
+            encoded = vote.encode()
+            _encode_tag(out, 14, _LEN)
+            _encode_varint(out, len(encoded))
+            out += encoded
+        _encode_uint_field(out, 15, self.expected_voters_count & _U32_MASK)
+        _encode_uint_field(out, 16, self.round & _U32_MASK)
+        _encode_uint_field(out, 17, self.timestamp & _U64_MASK)
+        _encode_uint_field(out, 18, self.expiration_timestamp & _U64_MASK)
+        _encode_bool_field(out, 19, self.liveness_criteria_yes)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Proposal":
+        proposal = cls()
+        pos = 0
+        n = len(data)
+        while pos < n:
+            key, pos = _decode_varint(data, pos)
+            field_number, wire_type = key >> 3, key & 7
+            if wire_type == _LEN and field_number in (10, 11, 13, 14):
+                length, pos = _decode_varint(data, pos)
+                end = _checked_end(data, pos, length)
+                value = data[pos:end]
+                pos = end
+                if field_number == 10:
+                    proposal.name = value.decode("utf-8")
+                elif field_number == 11:
+                    proposal.payload = value
+                elif field_number == 13:
+                    proposal.proposal_owner = value
+                else:
+                    proposal.votes.append(Vote.decode(value))
+            elif field_number == 12 and wire_type == _VARINT:
+                v, pos = _decode_varint(data, pos)
+                proposal.proposal_id = v & _U32_MASK
+            elif field_number == 15 and wire_type == _VARINT:
+                v, pos = _decode_varint(data, pos)
+                proposal.expected_voters_count = v & _U32_MASK
+            elif field_number == 16 and wire_type == _VARINT:
+                v, pos = _decode_varint(data, pos)
+                proposal.round = v & _U32_MASK
+            elif field_number == 17 and wire_type == _VARINT:
+                v, pos = _decode_varint(data, pos)
+                proposal.timestamp = v & _U64_MASK
+            elif field_number == 18 and wire_type == _VARINT:
+                v, pos = _decode_varint(data, pos)
+                proposal.expiration_timestamp = v & _U64_MASK
+            elif field_number == 19 and wire_type == _VARINT:
+                v, pos = _decode_varint(data, pos)
+                proposal.liveness_criteria_yes = bool(v)
+            else:
+                pos = _skip_field(data, pos, wire_type)
+        return proposal
+
+    def clone(self) -> "Proposal":
+        return Proposal(
+            name=self.name,
+            payload=self.payload,
+            proposal_id=self.proposal_id,
+            proposal_owner=self.proposal_owner,
+            votes=[v.clone() for v in self.votes],
+            expected_voters_count=self.expected_voters_count,
+            round=self.round,
+            timestamp=self.timestamp,
+            expiration_timestamp=self.expiration_timestamp,
+            liveness_criteria_yes=self.liveness_criteria_yes,
+        )

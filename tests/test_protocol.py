@@ -1,0 +1,317 @@
+"""Golden tests for the scalar protocol kernels.
+
+Cases transcribed from the reference's pure-function suites
+(reference: tests/threshold_tests.rs, tests/rfc_compliance_tests.rs:361-372,
+src/utils.rs:369-396). These tables are the bit-exactness oracle for the
+vectorized TPU kernels.
+"""
+
+import pytest
+
+from hashgraph_tpu.errors import (
+    InvalidConsensusThreshold,
+    InvalidExpectedVotersCount,
+    InvalidTimeout,
+    ParentHashMismatch,
+    ProposalExpired,
+    ReceivedHashMismatch,
+)
+from hashgraph_tpu.protocol import (
+    calculate_consensus_result,
+    calculate_max_rounds,
+    calculate_threshold_based_value,
+    compute_vote_hash,
+    decide,
+    fold_u128_to_u32,
+    generate_id,
+    has_sufficient_votes,
+    validate_expected_voters_count,
+    validate_proposal_timestamp,
+    validate_threshold,
+    validate_timeout,
+    validate_vote_chain,
+)
+from hashgraph_tpu.wire import Vote
+
+TWO_THIRDS = 2.0 / 3.0
+
+
+def yes_vote(i: int) -> Vote:
+    return Vote(
+        vote_id=i,
+        vote_owner=bytes([i]),
+        proposal_id=1,
+        timestamp=0,
+        vote=True,
+        vote_hash=bytes([i]),
+    )
+
+
+def no_vote(i: int) -> Vote:
+    v = yes_vote(i)
+    v.vote = False
+    return v
+
+
+def result_of(votes, n, threshold=TWO_THIRDS, liveness=True, is_timeout=False):
+    return calculate_consensus_result(
+        {v.vote_owner: v for v in votes}, n, threshold, liveness, is_timeout
+    )
+
+
+class TestThresholdRounding:
+    """reference: tests/threshold_tests.rs:9-38"""
+
+    def test_two_thirds_threshold_rounding(self):
+        t = TWO_THIRDS
+        assert has_sufficient_votes(1, 1, t)
+        assert not has_sufficient_votes(1, 2, t)
+        assert has_sufficient_votes(2, 2, t)
+        assert not has_sufficient_votes(1, 3, t)
+        assert has_sufficient_votes(2, 3, t)
+        assert not has_sufficient_votes(2, 4, t)
+        assert has_sufficient_votes(3, 4, t)
+        assert not has_sufficient_votes(3, 5, t)
+        assert has_sufficient_votes(4, 5, t)
+        assert not has_sufficient_votes(3, 6, t)
+        assert has_sufficient_votes(4, 6, t)
+        assert not has_sufficient_votes(66, 100, t)
+        assert has_sufficient_votes(67, 100, t)
+
+    def test_ceil_2n3_table(self):
+        """reference: tests/rfc_compliance_tests.rs:361-372"""
+        expected = {1: 1, 2: 2, 3: 2, 4: 3, 5: 4, 6: 4, 7: 5, 8: 6, 9: 6, 10: 7}
+        for n, want in expected.items():
+            assert calculate_threshold_based_value(n, TWO_THIRDS) == want
+            assert calculate_max_rounds(n, TWO_THIRDS) == want
+
+    def test_exact_integer_path_vs_float_path(self):
+        # The 2/3 special case must use integer div_ceil — for huge n the f64
+        # path would round differently.
+        for n in [3, 6, 9, 999, 3 * 10**8]:
+            assert calculate_threshold_based_value(n, TWO_THIRDS) == (2 * n + 2) // 3
+        # Non-2/3 thresholds take the f64 ceil path.
+        assert calculate_threshold_based_value(5, 0.9) == 5
+        assert calculate_threshold_based_value(5, 0.5) == 3
+        assert calculate_threshold_based_value(10, 0.61) == 7
+
+
+class TestConsensusResultVariants:
+    """reference: tests/threshold_tests.rs:41-165"""
+
+    def test_majority_yes(self):
+        assert result_of([yes_vote(1), yes_vote(2), no_vote(3)], 3, liveness=False) is True
+
+    def test_majority_no(self):
+        assert result_of([yes_vote(1), no_vote(2), no_vote(3)], 3, liveness=True) is False
+
+    def test_n2_tie_is_not_unanimous_yes(self):
+        votes = [yes_vote(1), no_vote(2)]
+        assert result_of(votes, 2, liveness=True) is False
+        assert result_of(votes, 2, liveness=False) is False
+
+    def test_strict_threshold_requires_more_yes(self):
+        votes = [yes_vote(1), yes_vote(2), yes_vote(3), no_vote(4), no_vote(5)]
+        assert result_of(votes, 5, threshold=0.9) is None
+
+    def test_fast_threshold_resolves_early(self):
+        votes = [yes_vote(1), yes_vote(2), no_vote(3)]
+        assert result_of(votes, 5, threshold=0.5) is True
+
+    def test_n2_timeout_still_requires_all_votes(self):
+        assert result_of([yes_vote(1)], 2, is_timeout=True) is None
+
+    def test_quorum_not_met_without_timeout(self):
+        votes = [yes_vote(1), yes_vote(2)]
+        assert result_of(votes, 4, liveness=True, is_timeout=False) is None
+
+    def test_timeout_silent_as_yes(self):
+        votes = [yes_vote(1), yes_vote(2)]
+        assert result_of(votes, 4, liveness=True, is_timeout=True) is True
+
+    def test_timeout_silent_as_no_splits_evenly(self):
+        votes = [yes_vote(1), yes_vote(2)]
+        assert result_of(votes, 4, liveness=False, is_timeout=True) is None
+
+    def test_timeout_one_yes_one_no_two_silent_yes(self):
+        votes = [yes_vote(1), no_vote(2)]
+        assert result_of(votes, 4, liveness=True, is_timeout=True) is True
+
+    def test_timeout_weighted_tie_is_none(self):
+        votes = [yes_vote(1), no_vote(2), no_vote(3)]
+        assert result_of(votes, 4, liveness=True, is_timeout=True) is None
+
+    def test_n1_unanimity(self):
+        assert result_of([yes_vote(1)], 1) is True
+        assert result_of([no_vote(1)], 1) is False
+        assert result_of([], 1) is None
+
+    def test_full_tie_breaks_by_liveness(self):
+        # n=4, 2 yes 2 no, everyone voted -> tie broken by liveness flag.
+        votes = [yes_vote(1), yes_vote(2), no_vote(3), no_vote(4)]
+        assert result_of(votes, 4, liveness=True) is True
+        assert result_of(votes, 4, liveness=False) is False
+
+    def test_decide_count_form_matches_vote_form(self):
+        for n in range(1, 8):
+            for total in range(0, n + 1):
+                for yes in range(0, total + 1):
+                    for liveness in (True, False):
+                        for is_timeout in (True, False):
+                            votes = [yes_vote(i) for i in range(yes)] + [
+                                no_vote(100 + i) for i in range(total - yes)
+                            ]
+                            assert decide(
+                                yes, total, n, TWO_THIRDS, liveness, is_timeout
+                            ) == result_of(
+                                votes, n, liveness=liveness, is_timeout=is_timeout
+                            )
+
+
+class TestIdGeneration:
+    def test_fold_does_not_collapse_distinct_values(self):
+        """reference: src/utils.rs:375-396"""
+        low = 0xDEADBEEF
+        a = (0x00000001 << 32) | low
+        b = (0xABCDEF01 << 32) | low
+        assert fold_u128_to_u32(a) != fold_u128_to_u32(b)
+
+    def test_generate_id_is_u32(self):
+        for _ in range(100):
+            assert 0 <= generate_id() <= 0xFFFFFFFF
+
+
+class TestVoteHash:
+    def test_deterministic_and_field_sensitive(self):
+        v = Vote(
+            vote_id=7,
+            vote_owner=b"\x01\x02",
+            proposal_id=9,
+            timestamp=1234,
+            vote=True,
+            parent_hash=b"p",
+            received_hash=b"r",
+        )
+        h1 = compute_vote_hash(v)
+        assert len(h1) == 32
+        assert compute_vote_hash(v) == h1
+        v2 = v.clone()
+        v2.vote = False
+        assert compute_vote_hash(v2) != h1
+        v3 = v.clone()
+        v3.signature = b"sig-does-not-matter"
+        assert compute_vote_hash(v3) == h1
+
+    def test_known_digest(self):
+        # Pinned digest: sha256(vote_id_le || owner || proposal_id_le ||
+        # timestamp_le || [vote] || parent || received)
+        import hashlib
+
+        v = Vote(vote_id=1, vote_owner=b"o", proposal_id=2, timestamp=3, vote=True)
+        manual = hashlib.sha256(
+            (1).to_bytes(4, "little")
+            + b"o"
+            + (2).to_bytes(4, "little")
+            + (3).to_bytes(8, "little")
+            + b"\x01"
+        ).digest()
+        assert compute_vote_hash(v) == manual
+
+
+class TestVoteChain:
+    def _mk(self, owner: bytes, ts: int, vote_hash: bytes, parent=b"", received=b""):
+        return Vote(
+            vote_owner=owner,
+            timestamp=ts,
+            vote_hash=vote_hash,
+            parent_hash=parent,
+            received_hash=received,
+        )
+
+    def test_short_chains_pass(self):
+        validate_vote_chain([])
+        validate_vote_chain([self._mk(b"a", 1, b"h1")])
+
+    def test_valid_received_chain(self):
+        v1 = self._mk(b"a", 1, b"h1")
+        v2 = self._mk(b"b", 2, b"h2", received=b"h1")
+        v3 = self._mk(b"c", 3, b"h3", received=b"h2")
+        validate_vote_chain([v1, v2, v3])
+
+    def test_received_hash_mismatch(self):
+        v1 = self._mk(b"a", 1, b"h1")
+        v2 = self._mk(b"b", 2, b"h2", received=b"WRONG")
+        with pytest.raises(ReceivedHashMismatch):
+            validate_vote_chain([v1, v2])
+
+    def test_received_timestamp_regression(self):
+        v1 = self._mk(b"a", 10, b"h1")
+        v2 = self._mk(b"b", 5, b"h2", received=b"h1")
+        with pytest.raises(ReceivedHashMismatch):
+            validate_vote_chain([v1, v2])
+
+    def test_empty_received_hash_skips_adjacency(self):
+        v1 = self._mk(b"a", 1, b"h1")
+        v2 = self._mk(b"b", 2, b"h2", received=b"")
+        validate_vote_chain([v1, v2])
+
+    def test_valid_parent_chain_same_owner(self):
+        v1 = self._mk(b"a", 1, b"h1")
+        v2 = self._mk(b"b", 2, b"h2", received=b"h1")
+        v3 = self._mk(b"a", 3, b"h3", parent=b"h1", received=b"h2")
+        validate_vote_chain([v1, v2, v3])
+
+    def test_parent_owner_mismatch(self):
+        v1 = self._mk(b"a", 1, b"h1")
+        v2 = self._mk(b"b", 2, b"h2", parent=b"h1", received=b"h1")
+        with pytest.raises(ParentHashMismatch):
+            validate_vote_chain([v1, v2])
+
+    def test_parent_unknown_hash(self):
+        v1 = self._mk(b"a", 1, b"h1")
+        v2 = self._mk(b"a", 2, b"h2", parent=b"NOPE", received=b"h1")
+        with pytest.raises(ParentHashMismatch):
+            validate_vote_chain([v1, v2])
+
+    def test_parent_must_be_earlier_index(self):
+        # Parent resolving to a later-indexed vote is rejected.
+        v1 = self._mk(b"a", 1, b"h1", parent=b"h2")
+        v2 = self._mk(b"a", 1, b"h2", received=b"h1")
+        with pytest.raises(ParentHashMismatch):
+            validate_vote_chain([v1, v2])
+
+    def test_parent_timestamp_regression(self):
+        v1 = self._mk(b"a", 10, b"h1")
+        v2 = self._mk(b"b", 10, b"h2", received=b"h1")
+        v3 = self._mk(b"a", 5, b"h3", parent=b"h1", received=b"")
+        with pytest.raises(ParentHashMismatch):
+            validate_vote_chain([v1, v2, v3])
+
+
+class TestValidators:
+    def test_proposal_timestamp(self):
+        validate_proposal_timestamp(100, 99)
+        with pytest.raises(ProposalExpired):
+            validate_proposal_timestamp(100, 100)
+        with pytest.raises(ProposalExpired):
+            validate_proposal_timestamp(100, 101)
+
+    def test_threshold_bounds(self):
+        validate_threshold(0.0)
+        validate_threshold(1.0)
+        validate_threshold(TWO_THIRDS)
+        with pytest.raises(InvalidConsensusThreshold):
+            validate_threshold(-0.01)
+        with pytest.raises(InvalidConsensusThreshold):
+            validate_threshold(1.01)
+
+    def test_timeout_positive(self):
+        validate_timeout(1)
+        validate_timeout(0.5)
+        with pytest.raises(InvalidTimeout):
+            validate_timeout(0)
+
+    def test_expected_voters_positive(self):
+        validate_expected_voters_count(1)
+        with pytest.raises(InvalidExpectedVotersCount):
+            validate_expected_voters_count(0)

@@ -1,0 +1,177 @@
+"""Wire codec tests: roundtrips, proto3 default omission, and byte-level
+compatibility with the canonical protobuf runtime (standing in for prost)."""
+
+import pytest
+
+from hashgraph_tpu.wire import Proposal, Vote
+
+
+def full_vote() -> Vote:
+    return Vote(
+        vote_id=0xDEADBEEF,
+        vote_owner=b"\x01" * 20,
+        proposal_id=42,
+        timestamp=1_700_000_000,
+        vote=True,
+        parent_hash=b"p" * 32,
+        received_hash=b"r" * 32,
+        vote_hash=b"h" * 32,
+        signature=b"s" * 65,
+    )
+
+
+def full_proposal() -> Proposal:
+    return Proposal(
+        name="upgrade-v2",
+        payload=b"\x00\x01\x02",
+        proposal_id=7,
+        proposal_owner=b"\x02" * 20,
+        votes=[full_vote(), Vote(vote_id=1, vote_owner=b"x", proposal_id=7)],
+        expected_voters_count=5,
+        round=2,
+        timestamp=1_700_000_000,
+        expiration_timestamp=1_700_000_060,
+        liveness_criteria_yes=True,
+    )
+
+
+class TestRoundtrip:
+    def test_vote_roundtrip(self):
+        v = full_vote()
+        assert Vote.decode(v.encode()) == v
+
+    def test_proposal_roundtrip(self):
+        p = full_proposal()
+        assert Proposal.decode(p.encode()) == p
+
+    def test_default_messages_encode_empty(self):
+        # proto3: all-default messages serialize to zero bytes.
+        assert Vote().encode() == b""
+        assert Proposal().encode() == b""
+        assert Vote.decode(b"") == Vote()
+
+    def test_false_vote_is_omitted(self):
+        v = Vote(vote_id=1, vote=False)
+        raw = v.encode()
+        # field 24 (vote) must not appear when false
+        assert (24 << 3) | 0 not in raw
+        assert Vote.decode(raw).vote is False
+
+    def test_signing_payload_blanks_signature_only(self):
+        v = full_vote()
+        blanked = v.clone()
+        blanked.signature = b""
+        assert v.signing_payload() == blanked.encode()
+
+    def test_u64_max_timestamp(self):
+        v = Vote(timestamp=2**64 - 1)
+        assert Vote.decode(v.encode()).timestamp == 2**64 - 1
+
+    def test_unknown_fields_skipped(self):
+        # A field number we never use (5, varint) must be skipped on decode.
+        extra = bytes([(5 << 3) | 0, 0x05]) + full_vote().encode()
+        assert Vote.decode(extra) == full_vote()
+
+
+class TestProstCompatibility:
+    """Encode with google.protobuf against the same schema and compare bytes.
+
+    prost and the canonical runtime both emit proto3 fields in ascending
+    field-number order with defaults omitted, so byte equality here implies
+    byte compatibility with the reference
+    (schema: reference src/protos/messages/v1/consensus.proto:5-29).
+    """
+
+    @pytest.fixture(scope="class")
+    def pb_classes(self):
+        pool_mod = pytest.importorskip("google.protobuf.descriptor_pool")
+        from google.protobuf import descriptor_pb2, message_factory
+
+        fd = descriptor_pb2.FileDescriptorProto()
+        fd.name = "consensus_compat.proto"
+        fd.package = "consensus.v1"
+        fd.syntax = "proto3"
+
+        vote = fd.message_type.add()
+        vote.name = "Vote"
+        for num, fname, ftype in [
+            (20, "vote_id", descriptor_pb2.FieldDescriptorProto.TYPE_UINT32),
+            (21, "vote_owner", descriptor_pb2.FieldDescriptorProto.TYPE_BYTES),
+            (22, "proposal_id", descriptor_pb2.FieldDescriptorProto.TYPE_UINT32),
+            (23, "timestamp", descriptor_pb2.FieldDescriptorProto.TYPE_UINT64),
+            (24, "vote", descriptor_pb2.FieldDescriptorProto.TYPE_BOOL),
+            (25, "parent_hash", descriptor_pb2.FieldDescriptorProto.TYPE_BYTES),
+            (26, "received_hash", descriptor_pb2.FieldDescriptorProto.TYPE_BYTES),
+            (27, "vote_hash", descriptor_pb2.FieldDescriptorProto.TYPE_BYTES),
+            (28, "signature", descriptor_pb2.FieldDescriptorProto.TYPE_BYTES),
+        ]:
+            f = vote.field.add()
+            f.name, f.number, f.type = fname, num, ftype
+            f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+
+        prop = fd.message_type.add()
+        prop.name = "Proposal"
+        for num, fname, ftype, extra in [
+            (10, "name", descriptor_pb2.FieldDescriptorProto.TYPE_STRING, None),
+            (11, "payload", descriptor_pb2.FieldDescriptorProto.TYPE_BYTES, None),
+            (12, "proposal_id", descriptor_pb2.FieldDescriptorProto.TYPE_UINT32, None),
+            (13, "proposal_owner", descriptor_pb2.FieldDescriptorProto.TYPE_BYTES, None),
+            (14, "votes", descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE, ".consensus.v1.Vote"),
+            (15, "expected_voters_count", descriptor_pb2.FieldDescriptorProto.TYPE_UINT32, None),
+            (16, "round", descriptor_pb2.FieldDescriptorProto.TYPE_UINT32, None),
+            (17, "timestamp", descriptor_pb2.FieldDescriptorProto.TYPE_UINT64, None),
+            (18, "expiration_timestamp", descriptor_pb2.FieldDescriptorProto.TYPE_UINT64, None),
+            (19, "liveness_criteria_yes", descriptor_pb2.FieldDescriptorProto.TYPE_BOOL, None),
+        ]:
+            f = prop.field.add()
+            f.name, f.number, f.type = fname, num, ftype
+            if fname == "votes":
+                f.label = descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
+                f.type_name = extra
+            else:
+                f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+
+        pool = pool_mod.DescriptorPool()
+        pool.Add(fd)
+        msgs = message_factory.GetMessageClassesForFiles(["consensus_compat.proto"], pool)
+        return msgs["consensus.v1.Vote"], msgs["consensus.v1.Proposal"]
+
+    def _pb_vote(self, PbVote, v: Vote):
+        m = PbVote()
+        m.vote_id = v.vote_id
+        m.vote_owner = v.vote_owner
+        m.proposal_id = v.proposal_id
+        m.timestamp = v.timestamp
+        m.vote = v.vote
+        m.parent_hash = v.parent_hash
+        m.received_hash = v.received_hash
+        m.vote_hash = v.vote_hash
+        m.signature = v.signature
+        return m
+
+    def test_vote_bytes_match(self, pb_classes):
+        PbVote, _ = pb_classes
+        for v in [full_vote(), Vote(), Vote(vote_id=1), Vote(vote=True, timestamp=2**63)]:
+            assert v.encode() == self._pb_vote(PbVote, v).SerializeToString()
+
+    def test_proposal_bytes_match(self, pb_classes):
+        PbVote, PbProposal = pb_classes
+        p = full_proposal()
+        m = PbProposal()
+        m.name = p.name
+        m.payload = p.payload
+        m.proposal_id = p.proposal_id
+        m.proposal_owner = p.proposal_owner
+        for v in p.votes:
+            m.votes.append(self._pb_vote(PbVote, v))
+        m.expected_voters_count = p.expected_voters_count
+        m.round = p.round
+        m.timestamp = p.timestamp
+        m.expiration_timestamp = p.expiration_timestamp
+        m.liveness_criteria_yes = p.liveness_criteria_yes
+        assert p.encode() == m.SerializeToString()
+
+    def test_decode_canonical_bytes(self, pb_classes):
+        PbVote, _ = pb_classes
+        v = full_vote()
+        assert Vote.decode(self._pb_vote(PbVote, v).SerializeToString()) == v
