@@ -54,10 +54,21 @@ from .protocol import (
     validate_proposal,
     validate_vote_chain,
 )
+from .events import BroadcastEventBus, ConsensusEventBus, EventReceiver
+from .scope_config import NetworkType, ScopeConfig, ScopeConfigBuilder
+from .service import ConsensusService, ConsensusStats, ScopeConfigBuilderWrapper
+from .session import ConsensusConfig, ConsensusSession, ConsensusState
 from .signing import (
     ConsensusSignatureScheme,
     EthereumConsensusSigner,
     StubConsensusSigner,
+)
+from .storage import ConsensusStorage, InMemoryConsensusStorage
+from .types import (
+    ConsensusFailedEvent,
+    ConsensusReached,
+    CreateProposalRequest,
+    SessionTransition,
 )
 from .wire import Proposal, Vote
 
@@ -66,6 +77,24 @@ __version__ = "0.1.0"
 __all__ = [
     "Proposal",
     "Vote",
+    "ConsensusService",
+    "ConsensusStats",
+    "ConsensusConfig",
+    "ConsensusSession",
+    "ConsensusState",
+    "ConsensusStorage",
+    "InMemoryConsensusStorage",
+    "ConsensusEventBus",
+    "BroadcastEventBus",
+    "EventReceiver",
+    "NetworkType",
+    "ScopeConfig",
+    "ScopeConfigBuilder",
+    "ScopeConfigBuilderWrapper",
+    "CreateProposalRequest",
+    "ConsensusReached",
+    "ConsensusFailedEvent",
+    "SessionTransition",
     "ConsensusSignatureScheme",
     "EthereumConsensusSigner",
     "StubConsensusSigner",

@@ -305,8 +305,10 @@ def validate_timeout(timeout_seconds: float) -> None:
 
 
 def validate_expected_voters_count(expected_voters_count: int) -> None:
-    """expected_voters_count must be >= 1 (reference: src/utils.rs:347-354)."""
-    if expected_voters_count == 0:
+    """expected_voters_count must be a valid nonzero u32
+    (reference: src/utils.rs:347-354; values outside u32 range are
+    unrepresentable in the reference's wire type)."""
+    if not (1 <= expected_voters_count <= _U32_MAX):
         raise InvalidExpectedVotersCount()
 
 

@@ -1,0 +1,136 @@
+"""Scope-level configuration: per-scope defaults every proposal inherits.
+
+Mirrors the reference semantics (reference: src/scope_config.rs): a scope
+holds a network type (Gossipsub/P2P round presets — these are round-semantics
+presets, not transports), a default threshold/timeout/liveness, and an
+optional max-rounds override. Timeouts are float seconds (the reference uses
+``Duration``).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+from .errors import InvalidMaxRounds
+from .protocol import validate_threshold, validate_timeout
+
+DEFAULT_TIMEOUT_SECONDS = 60.0  # reference: src/scope_config.rs:13
+
+
+class NetworkType(enum.Enum):
+    """Round/vote semantics preset (reference: src/scope_config.rs:17-23)."""
+
+    GOSSIPSUB = "gossipsub"  # 2 rounds, all votes land in round 2
+    P2P = "p2p"  # dynamic ceil(2n/3) cap, each vote increments the round
+
+
+@dataclass
+class ScopeConfig:
+    """Per-scope defaults (reference: src/scope_config.rs:30-53)."""
+
+    network_type: NetworkType = NetworkType.GOSSIPSUB
+    default_consensus_threshold: float = 2.0 / 3.0
+    default_timeout: float = DEFAULT_TIMEOUT_SECONDS
+    default_liveness_criteria_yes: bool = True
+    max_rounds_override: int | None = None
+
+    def validate(self) -> None:
+        """reference: src/scope_config.rs:57-69 — Some(0) override is only
+        legal for P2P (it triggers dynamic calculation). Negative overrides
+        are unrepresentable in the reference's u32 and rejected here."""
+        validate_threshold(self.default_consensus_threshold)
+        validate_timeout(self.default_timeout)
+        if self.max_rounds_override is not None:
+            if self.max_rounds_override < 0:
+                raise InvalidMaxRounds()
+            if (
+                self.max_rounds_override == 0
+                and self.network_type == NetworkType.GOSSIPSUB
+            ):
+                raise InvalidMaxRounds()
+
+    def clone(self) -> "ScopeConfig":
+        return ScopeConfig(
+            network_type=self.network_type,
+            default_consensus_threshold=self.default_consensus_threshold,
+            default_timeout=self.default_timeout,
+            default_liveness_criteria_yes=self.default_liveness_criteria_yes,
+            max_rounds_override=self.max_rounds_override,
+        )
+
+    @classmethod
+    def from_network_type(cls, network_type: NetworkType) -> "ScopeConfig":
+        """reference: src/scope_config.rs:72-91 — both presets share the
+        2/3 threshold, 60s timeout, liveness=True defaults."""
+        return cls(network_type=network_type)
+
+
+class ScopeConfigBuilder:
+    """Fluent builder with presets (reference: src/scope_config.rs:93-204)."""
+
+    def __init__(self, config: ScopeConfig | None = None):
+        self._config = config.clone() if config is not None else ScopeConfig()
+
+    @classmethod
+    def from_existing(cls, config: ScopeConfig) -> "ScopeConfigBuilder":
+        return cls(config)
+
+    def with_network_type(self, network_type: NetworkType) -> "ScopeConfigBuilder":
+        self._config.network_type = network_type
+        return self
+
+    def with_threshold(self, threshold: float) -> "ScopeConfigBuilder":
+        self._config.default_consensus_threshold = threshold
+        return self
+
+    def with_timeout(self, timeout_seconds: float) -> "ScopeConfigBuilder":
+        self._config.default_timeout = timeout_seconds
+        return self
+
+    def with_liveness_criteria(self, liveness_criteria_yes: bool) -> "ScopeConfigBuilder":
+        self._config.default_liveness_criteria_yes = liveness_criteria_yes
+        return self
+
+    def with_max_rounds(self, max_rounds: int | None) -> "ScopeConfigBuilder":
+        self._config.max_rounds_override = max_rounds
+        return self
+
+    def p2p_preset(self) -> "ScopeConfigBuilder":
+        """reference: src/scope_config.rs:140-147"""
+        self._config = ScopeConfig(network_type=NetworkType.P2P)
+        return self
+
+    def gossipsub_preset(self) -> "ScopeConfigBuilder":
+        """reference: src/scope_config.rs:150-157"""
+        self._config = ScopeConfig(network_type=NetworkType.GOSSIPSUB)
+        return self
+
+    def strict_consensus(self) -> "ScopeConfigBuilder":
+        """Higher threshold = 0.9 (reference: src/scope_config.rs:160-163)."""
+        self._config.default_consensus_threshold = 0.9
+        return self
+
+    def fast_consensus(self) -> "ScopeConfigBuilder":
+        """Lower threshold = 0.6, 30s timeout (reference: src/scope_config.rs:166-170)."""
+        self._config.default_consensus_threshold = 0.6
+        self._config.default_timeout = 30.0
+        return self
+
+    def with_network_defaults(self, network_type: NetworkType) -> "ScopeConfigBuilder":
+        """Reset network/threshold/timeout to the preset, preserving liveness
+        and max-rounds override (reference: src/scope_config.rs:173-187)."""
+        self._config.network_type = network_type
+        self._config.default_consensus_threshold = 2.0 / 3.0
+        self._config.default_timeout = DEFAULT_TIMEOUT_SECONDS
+        return self
+
+    def validate(self) -> None:
+        self._config.validate()
+
+    def build(self) -> ScopeConfig:
+        self.validate()
+        return self._config.clone()
+
+    def get_config(self) -> ScopeConfig:
+        return self._config.clone()

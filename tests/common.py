@@ -1,0 +1,71 @@
+"""Helpers shared by the service-level test suites
+(reference: tests/common/mod.rs).
+
+Multi-peer behavior is tested in-process: services share storage/event bus
+and messages are hand-delivered, exactly as the reference does. Time is
+synthetic — every API takes caller-supplied ``now`` so tests advance the
+clock arithmetically instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import os
+
+from hashgraph_tpu import (
+    BroadcastEventBus,
+    ConsensusService,
+    EthereumConsensusSigner,
+    InMemoryConsensusStorage,
+    Proposal,
+    StubConsensusSigner,
+    Vote,
+    build_vote,
+)
+
+NOW = 1_700_000_000  # fixed synthetic "current time" base
+
+
+def now_ts() -> int:
+    return NOW
+
+
+def random_stub_signer() -> StubConsensusSigner:
+    return StubConsensusSigner(os.urandom(20))
+
+
+def make_service(scheme: str = "stub", max_sessions: int = 10) -> ConsensusService:
+    """Fresh service with in-memory storage + broadcast bus.
+
+    ``scheme="stub"`` keeps suites fast; ``scheme="ethereum"`` exercises real
+    ECDSA (used by crypto-sensitive suites).
+    """
+    signer = (
+        random_stub_signer() if scheme == "stub" else EthereumConsensusSigner.random()
+    )
+    return ConsensusService(
+        InMemoryConsensusStorage(), BroadcastEventBus(), signer, max_sessions
+    )
+
+
+def sibling_service(service: ConsensusService, scheme: str = "stub") -> ConsensusService:
+    """Another peer's view: same storage + bus, its own signer."""
+    signer = (
+        random_stub_signer() if scheme == "stub" else EthereumConsensusSigner.random()
+    )
+    return ConsensusService(service.storage(), service.event_bus(), signer)
+
+
+def cast_remote_vote(service, scope, proposal_id, choice, signer, now=NOW) -> Vote:
+    """Build + deliver a vote as if from a remote peer
+    (reference: tests/common/mod.rs:44-55)."""
+    proposal = service.storage().get_proposal(scope, proposal_id)
+    vote = build_vote(proposal, choice, signer, now)
+    service.process_incoming_vote(scope, vote.clone(), now)
+    return vote
+
+
+def cast_remote_vote_and_get_proposal(
+    service, scope, proposal_id, choice, signer, now=NOW
+) -> Proposal:
+    cast_remote_vote(service, scope, proposal_id, choice, signer, now)
+    return service.storage().get_proposal(scope, proposal_id)

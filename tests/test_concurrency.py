@@ -1,0 +1,130 @@
+"""Concurrency behavior (reference: tests/concurrency_tests.rs): parallel
+voters against a shared service must serialize correctly."""
+
+import threading
+
+from hashgraph_tpu import CreateProposalRequest, ConsensusConfig, build_vote
+from hashgraph_tpu.errors import ConsensusError, DuplicateVote
+
+from common import NOW, make_service, random_stub_signer, sibling_service
+
+SCOPE = "concurrency_scope"
+
+
+def test_parallel_voters_all_succeed():
+    """reference: tests/concurrency_tests.rs:44-99 — 10 distinct voters race;
+    all succeed."""
+    service = make_service()
+    request = CreateProposalRequest(
+        name="Concurrent",
+        payload=b"",
+        proposal_owner=service.signer().identity(),
+        expected_voters_count=30,  # high n so consensus can't close the session early
+        expiration_timestamp=120,
+        liveness_criteria_yes=True,
+    )
+    proposal = service.create_proposal_with_config(
+        SCOPE, request, ConsensusConfig.gossipsub(), NOW
+    )
+
+    n_threads = 10
+    barrier = threading.Barrier(n_threads)
+    errors = []
+
+    def vote_thread():
+        peer = sibling_service(service)
+        barrier.wait()
+        try:
+            peer.cast_vote(SCOPE, proposal.proposal_id, True, NOW)
+        except ConsensusError as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=vote_thread) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert errors == []
+    stored = service.storage().get_proposal(SCOPE, proposal.proposal_id)
+    assert len(stored.votes) == n_threads
+
+
+def test_parallel_proposal_creation():
+    """reference: tests/concurrency_tests.rs:103-142"""
+    service = make_service(max_sessions=100)
+    barrier = threading.Barrier(8)
+    ids = []
+    lock = threading.Lock()
+
+    def create_thread(i):
+        request = CreateProposalRequest(
+            name=f"p{i}",
+            payload=b"",
+            proposal_owner=random_stub_signer().identity(),
+            expected_voters_count=3,
+            expiration_timestamp=120,
+            liveness_criteria_yes=True,
+        )
+        barrier.wait()
+        proposal = service.create_proposal(SCOPE, request, NOW)
+        with lock:
+            ids.append(proposal.proposal_id)
+
+    threads = [threading.Thread(target=create_thread, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert len(set(ids)) == 8
+    sessions = service.storage().list_scope_sessions(SCOPE)
+    assert len(sessions) == 8
+
+
+def test_same_voter_race_single_success():
+    """reference: tests/concurrency_tests.rs:146-228 — 5 threads with the SAME
+    identity racing: exactly 1 success, 4 duplicate errors."""
+    service = make_service()
+    request = CreateProposalRequest(
+        name="Race",
+        payload=b"",
+        proposal_owner=service.signer().identity(),
+        expected_voters_count=30,
+        expiration_timestamp=120,
+        liveness_criteria_yes=True,
+    )
+    proposal = service.create_proposal_with_config(
+        SCOPE, request, ConsensusConfig.gossipsub(), NOW
+    )
+
+    racer = random_stub_signer()
+    n_threads = 5
+    barrier = threading.Barrier(n_threads)
+    outcomes = []
+    lock = threading.Lock()
+
+    def race_thread():
+        # Each thread builds its own vote from the pre-vote snapshot and
+        # delivers it; the in-lock duplicate check must let exactly one in.
+        snapshot = service.storage().get_proposal(SCOPE, proposal.proposal_id)
+        vote = build_vote(snapshot, True, racer, NOW)
+        barrier.wait()
+        try:
+            service.process_incoming_vote(SCOPE, vote, NOW)
+            result = "ok"
+        except DuplicateVote:
+            result = "duplicate"
+        with lock:
+            outcomes.append(result)
+
+    threads = [threading.Thread(target=race_thread) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert outcomes.count("ok") == 1
+    assert outcomes.count("duplicate") == n_threads - 1
+    stored = service.storage().get_proposal(SCOPE, proposal.proposal_id)
+    assert len(stored.votes) == 1

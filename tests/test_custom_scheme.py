@@ -1,0 +1,111 @@
+"""Scheme-agnosticism: the service works with any signature scheme
+(reference: tests/custom_scheme_tests.rs)."""
+
+import hashlib
+
+import pytest
+
+from hashgraph_tpu import (
+    BroadcastEventBus,
+    ConsensusService,
+    CreateProposalRequest,
+    InMemoryConsensusStorage,
+    StubConsensusSigner,
+    build_vote,
+)
+from hashgraph_tpu.errors import InvalidVoteSignature
+from hashgraph_tpu.signing import ConsensusSignatureScheme
+
+from common import NOW
+
+SCOPE = "custom_scheme_scope"
+
+
+class PrefixScheme(ConsensusSignatureScheme):
+    """A from-scratch scheme (not the built-in stub): signature =
+    sha256(b'custom:' || identity || payload)."""
+
+    def __init__(self, identity: bytes):
+        self._identity = identity
+
+    def identity(self) -> bytes:
+        return self._identity
+
+    def sign(self, payload: bytes) -> bytes:
+        return hashlib.sha256(b"custom:" + self._identity + payload).digest()
+
+    @classmethod
+    def verify(cls, identity, payload, signature) -> bool:
+        return hashlib.sha256(b"custom:" + bytes(identity) + payload).digest() == signature
+
+
+def make_custom_service(identity=b"peer-A" + b"\x00" * 14):
+    return ConsensusService(
+        InMemoryConsensusStorage(), BroadcastEventBus(), PrefixScheme(identity)
+    )
+
+
+def test_consensus_with_custom_scheme():
+    """reference: tests/custom_scheme_tests.rs:91-136"""
+    service = make_custom_service()
+    request = CreateProposalRequest(
+        name="Custom",
+        payload=b"",
+        proposal_owner=service.signer().identity(),
+        expected_voters_count=3,
+        expiration_timestamp=60,
+        liveness_criteria_yes=True,
+    )
+    proposal = service.create_proposal(SCOPE, request, NOW)
+    service.cast_vote(SCOPE, proposal.proposal_id, True, NOW)
+
+    peer = ConsensusService(
+        service.storage(), service.event_bus(), PrefixScheme(b"peer-B" + b"\x00" * 14)
+    )
+    peer.cast_vote(SCOPE, proposal.proposal_id, True, NOW)
+    assert service.storage().get_consensus_result(SCOPE, proposal.proposal_id) is True
+
+
+def test_forged_signature_rejected_by_custom_scheme():
+    """reference: tests/custom_scheme_tests.rs:139-178"""
+    service = make_custom_service()
+    request = CreateProposalRequest(
+        name="Forged",
+        payload=b"",
+        proposal_owner=service.signer().identity(),
+        expected_voters_count=3,
+        expiration_timestamp=60,
+        liveness_criteria_yes=True,
+    )
+    proposal = service.create_proposal(SCOPE, request, NOW)
+    snapshot = service.storage().get_proposal(SCOPE, proposal.proposal_id)
+
+    voter = PrefixScheme(b"peer-V" + b"\x00" * 14)
+    vote = build_vote(snapshot, True, voter, NOW)
+    # Tamper with the signature so verify() returns False (hash still valid).
+    vote.signature = bytes(b ^ 0xFF for b in vote.signature)
+
+    with pytest.raises(InvalidVoteSignature):
+        service.process_incoming_vote(SCOPE, vote, NOW)
+
+
+def test_schemes_do_not_cross_validate():
+    """A vote signed under one scheme fails under another service's scheme."""
+    stub_service = ConsensusService(
+        InMemoryConsensusStorage(), BroadcastEventBus(), StubConsensusSigner(b"stub-peer")
+    )
+    request = CreateProposalRequest(
+        name="Cross",
+        payload=b"",
+        proposal_owner=b"stub-peer",
+        expected_voters_count=3,
+        expiration_timestamp=60,
+        liveness_criteria_yes=True,
+    )
+    proposal = stub_service.create_proposal(SCOPE, request, NOW)
+    snapshot = stub_service.storage().get_proposal(SCOPE, proposal.proposal_id)
+
+    custom_voter = PrefixScheme(b"custom-peer")
+    vote = build_vote(snapshot, True, custom_voter, NOW)
+    with pytest.raises(InvalidVoteSignature):
+        stub_service.process_incoming_vote(SCOPE, vote, NOW)

@@ -1,0 +1,123 @@
+"""Simulated multi-peer convergence (reference: tests/network_gossip_tests.rs):
+independent services per peer, messages hand-ferried as wire bytes."""
+
+import pytest
+
+from hashgraph_tpu import (
+    ConsensusConfig,
+    CreateProposalRequest,
+    Proposal,
+    Vote,
+)
+from hashgraph_tpu.errors import InsufficientVotesAtTimeout
+
+from common import NOW, make_service, sibling_service
+
+SCOPE = "gossip_scope"
+
+
+def create_on(service, n, liveness=True):
+    request = CreateProposalRequest(
+        name="Gossip",
+        payload=b"",
+        proposal_owner=service.signer().identity(),
+        expected_voters_count=n,
+        expiration_timestamp=120,
+        liveness_criteria_yes=liveness,
+    )
+    return service.create_proposal_with_config(
+        SCOPE, request, ConsensusConfig.gossipsub(), NOW
+    )
+
+
+def ferry_proposal(src_proposal: Proposal, dst_service):
+    """Serialize and deliver a proposal as the network would."""
+    dst_service.process_incoming_proposal(
+        SCOPE, Proposal.decode(src_proposal.encode()), NOW
+    )
+
+
+def ferry_vote(vote: Vote, dst_service):
+    dst_service.process_incoming_vote(SCOPE, Vote.decode(vote.encode()), NOW)
+
+
+def test_two_peer_unanimous_yes():
+    """reference: tests/network_gossip_tests.rs:21-76"""
+    alice = make_service()
+    bob = make_service()  # separate storage: a genuinely remote peer
+
+    proposal = create_on(alice, 2)
+    vote_a = alice.cast_vote(SCOPE, proposal.proposal_id, True, NOW)
+
+    # Bob receives the updated proposal (with Alice's vote embedded).
+    ferry_proposal(alice.storage().get_proposal(SCOPE, proposal.proposal_id), bob)
+    vote_b = bob.cast_vote(SCOPE, proposal.proposal_id, True, NOW)
+
+    # Alice receives Bob's vote.
+    ferry_vote(vote_b, alice)
+
+    assert alice.storage().get_consensus_result(SCOPE, proposal.proposal_id) is True
+    assert bob.storage().get_consensus_result(SCOPE, proposal.proposal_id) is True
+    assert vote_a.vote_owner != vote_b.vote_owner
+
+
+def test_three_peer_out_of_order_delivery():
+    """reference: tests/network_gossip_tests.rs:81-152 — votes arrive in
+    different orders at different peers, all converge."""
+    alice, bob, carol = make_service(), make_service(), make_service()
+
+    proposal = create_on(alice, 3)
+    raw = alice.storage().get_proposal(SCOPE, proposal.proposal_id)
+    ferry_proposal(raw, bob)
+    ferry_proposal(raw, carol)
+
+    vote_a = alice.cast_vote(SCOPE, proposal.proposal_id, True, NOW)
+    vote_b = bob.cast_vote(SCOPE, proposal.proposal_id, True, NOW)
+
+    # Carol gets B then A; Alice gets B; Bob gets A.
+    ferry_vote(vote_b, carol)
+    ferry_vote(vote_a, carol)
+    ferry_vote(vote_b, alice)
+    ferry_vote(vote_a, bob)
+
+    for peer in (alice, bob, carol):
+        assert peer.storage().get_consensus_result(SCOPE, proposal.proposal_id) is True
+
+
+def test_multi_peer_timeout_converges_to_failed():
+    """reference: tests/network_gossip_tests.rs:159-254 — insufficient votes +
+    liveness=False tie -> every peer's timeout lands on Failed."""
+    peers = [make_service() for _ in range(3)]
+    proposal = create_on(peers[0], 4, liveness=False)
+    raw = peers[0].storage().get_proposal(SCOPE, proposal.proposal_id)
+    for p in peers[1:]:
+        ferry_proposal(raw, p)
+
+    # Two YES votes gossiped everywhere; 2 silent-as-NO -> weighted tie.
+    v0 = peers[0].cast_vote(SCOPE, proposal.proposal_id, True, NOW)
+    v1 = peers[1].cast_vote(SCOPE, proposal.proposal_id, True, NOW)
+    from hashgraph_tpu.errors import DuplicateVote
+
+    for vote in (v0, v1):
+        for p in peers:
+            try:
+                ferry_vote(vote, p)
+            except DuplicateVote:
+                pass  # the casting peer already holds its own vote
+
+    for p in peers:
+        with pytest.raises(InsufficientVotesAtTimeout):
+            p.handle_consensus_timeout(SCOPE, proposal.proposal_id, NOW + 60)
+
+
+def test_tie_resolved_yes_by_liveness_everywhere():
+    """reference: tests/network_gossip_tests.rs:259-377"""
+    shared = make_service()
+    peers = [shared] + [sibling_service(shared) for _ in range(3)]
+
+    proposal = create_on(peers[0], 4, liveness=True)
+    for i, choice in enumerate([True, True, False, False]):
+        peers[i].cast_vote(SCOPE, proposal.proposal_id, choice, NOW)
+
+    # 2-2 with everyone voted: tie broken YES by liveness.
+    assert shared.storage().get_consensus_result(SCOPE, proposal.proposal_id) is True

@@ -1,0 +1,263 @@
+"""RFC-keyed protocol invariants (reference: tests/rfc_compliance_tests.rs).
+
+Round initialization/increment semantics, gossipsub round-2 behavior, P2P
+dynamic caps, batch vote processing, n<=2 unanimity, majority rules, expiry,
+replay protection, and vote-equality handling.
+"""
+
+import pytest
+
+from hashgraph_tpu import (
+    ConsensusConfig,
+    CreateProposalRequest,
+    build_vote,
+    compute_vote_hash,
+)
+from hashgraph_tpu.errors import (
+    ConsensusNotReached,
+    ProposalExpired,
+    TimestampOlderThanCreationTime,
+    VoteExpired,
+)
+
+from common import (
+    NOW,
+    cast_remote_vote,
+    cast_remote_vote_and_get_proposal,
+    make_service,
+    random_stub_signer,
+)
+
+SCOPE = "rfc_compliance_scope"
+EXPIRATION = 120
+
+
+def create(service, scope, n, config, liveness=True, now=NOW, name="RFC Test"):
+    request = CreateProposalRequest(
+        name=name,
+        payload=b"",
+        proposal_owner=random_stub_signer().identity(),
+        expected_voters_count=n,
+        expiration_timestamp=EXPIRATION,
+        liveness_criteria_yes=liveness,
+    )
+    return service.create_proposal_with_config(scope, request, config, now)
+
+
+class TestRoundSemantics:
+    def test_proposal_initialization_round_is_one(self):
+        service = make_service()
+        proposal = create(service, SCOPE, 3, ConsensusConfig.gossipsub())
+        assert proposal.round == 1
+
+    def test_round_increments_on_vote_p2p(self):
+        service = make_service()
+        proposal = create(service, SCOPE, 3, ConsensusConfig.p2p())
+        assert proposal.round == 1
+        proposal = cast_remote_vote_and_get_proposal(
+            service, SCOPE, proposal.proposal_id, True, random_stub_signer()
+        )
+        assert proposal.round == 2
+        proposal = cast_remote_vote_and_get_proposal(
+            service, SCOPE, proposal.proposal_id, True, random_stub_signer()
+        )
+        assert proposal.round == 3
+
+    def test_gossipsub_rounds_stay_at_two(self):
+        service = make_service()
+        proposal = create(service, SCOPE, 5, ConsensusConfig.gossipsub())
+        for i in range(3):
+            proposal = cast_remote_vote_and_get_proposal(
+                service, SCOPE, proposal.proposal_id, True, random_stub_signer()
+            )
+            assert proposal.round == 2
+            assert len(proposal.votes) == i + 1
+
+    def test_gossipsub_allows_multiple_votes_in_round_two(self):
+        service = make_service()
+        proposal = create(service, SCOPE, 12, ConsensusConfig.gossipsub())
+        for _ in range(7):
+            proposal = cast_remote_vote_and_get_proposal(
+                service, SCOPE, proposal.proposal_id, True, random_stub_signer()
+            )
+            assert proposal.round == 2
+        assert len(proposal.votes) == 7
+
+    def test_p2p_dynamic_max_rounds(self):
+        # n=9: cap = ceil(2*9/3) = 6 votes; final round = 7; consensus YES.
+        service = make_service()
+        proposal = create(service, SCOPE, 9, ConsensusConfig.p2p())
+        for i in range(6):
+            proposal = cast_remote_vote_and_get_proposal(
+                service, SCOPE, proposal.proposal_id, True, random_stub_signer()
+            )
+            assert proposal.round == i + 2
+        assert len(proposal.votes) == 6
+        assert proposal.round == 7
+        assert service.storage().get_consensus_result(SCOPE, proposal.proposal_id) is True
+
+    @pytest.mark.parametrize(
+        "n,max_votes",
+        [(1, 1), (2, 2), (3, 2), (4, 3), (5, 4), (6, 4), (7, 5), (8, 6), (9, 6), (10, 7)],
+    )
+    def test_p2p_ceil_calculation_edge_cases(self, n, max_votes):
+        """Live sessions must admit exactly ceil(2n/3) votes in P2P mode.
+        (Sessions may reach consensus mid-way; vote count still proceeds to
+        the cap since add_vote on a reached session is a no-op success.)"""
+        service = make_service()
+        proposal = create(service, SCOPE, n, ConsensusConfig.p2p(), name=f"n={n}")
+        accepted = 0
+        for _ in range(max_votes):
+            proposal_snapshot = service.storage().get_proposal(SCOPE, proposal.proposal_id)
+            vote = build_vote(proposal_snapshot, True, random_stub_signer(), NOW)
+            service.process_incoming_vote(SCOPE, vote, NOW)
+            accepted += 1
+        final = service.storage().get_proposal(SCOPE, proposal.proposal_id)
+        assert accepted == max_votes
+        # All unanimous-YES runs reach consensus at/ before the cap, so votes
+        # stop being inserted once reached; the cap was never exceeded.
+        assert len(final.votes) <= max_votes
+
+
+class TestBatchProcessing:
+    def test_gossipsub_batch_vote_processing(self):
+        service = make_service()
+        scope = "batch_gossipsub"
+        request = CreateProposalRequest(
+            name="Batch",
+            payload=b"",
+            proposal_owner=random_stub_signer().identity(),
+            expected_voters_count=5,
+            expiration_timestamp=EXPIRATION,
+            liveness_criteria_yes=True,
+        )
+        proposal = request.into_proposal(NOW)
+        for i in range(3):
+            vote = build_vote(proposal, True, random_stub_signer(), NOW)
+            proposal.votes.append(vote)
+            proposal.round = 2
+
+        service.process_incoming_proposal(scope, proposal.clone(), NOW)
+        final = cast_remote_vote_and_get_proposal(
+            service, scope, proposal.proposal_id, True, random_stub_signer()
+        )
+        assert final.round == 2
+        assert len(final.votes) == 4
+
+    def test_p2p_batch_vote_processing(self):
+        service = make_service()
+        scope = "batch_p2p"
+        request = CreateProposalRequest(
+            name="Batch",
+            payload=b"",
+            proposal_owner=random_stub_signer().identity(),
+            expected_voters_count=9,
+            expiration_timestamp=EXPIRATION,
+            liveness_criteria_yes=True,
+        )
+        proposal = request.into_proposal(NOW)
+        for i in range(6):
+            vote = build_vote(proposal, True, random_stub_signer(), NOW)
+            proposal.votes.append(vote)
+            proposal.round = i + 2
+
+        service.process_incoming_proposal(scope, proposal.clone(), NOW)
+        assert service.storage().get_consensus_result(scope, proposal.proposal_id) is True
+
+        # Further votes cannot change the decided result.
+        cast_remote_vote(service, scope, proposal.proposal_id, False, random_stub_signer())
+        assert service.storage().get_consensus_result(scope, proposal.proposal_id) is True
+
+
+class TestConsensusRules:
+    def test_consensus_reachable_in_both_modes(self):
+        service = make_service()
+        for scope, config in [
+            ("gossipsub_consensus", ConsensusConfig.gossipsub()),
+            ("p2p_consensus", ConsensusConfig.p2p()),
+        ]:
+            proposal = create(service, scope, 6, config)
+            for _ in range(4):
+                cast_remote_vote(
+                    service, scope, proposal.proposal_id, True, random_stub_signer()
+                )
+            assert service.storage().get_consensus_result(scope, proposal.proposal_id) is True
+
+    def test_n_le_2_requires_unanimous_yes(self):
+        service = make_service()
+        # n=1: single YES decides immediately.
+        p1 = create(service, "n1", 1, ConsensusConfig.gossipsub())
+        cast_remote_vote(service, "n1", p1.proposal_id, True, random_stub_signer())
+        assert service.storage().get_consensus_result("n1", p1.proposal_id) is True
+
+        # n=2 both YES -> True.
+        p2 = create(service, "n2", 2, ConsensusConfig.gossipsub())
+        cast_remote_vote(service, "n2", p2.proposal_id, True, random_stub_signer())
+        cast_remote_vote(service, "n2", p2.proposal_id, True, random_stub_signer())
+        assert service.storage().get_consensus_result("n2", p2.proposal_id) is True
+
+        # n=2 one YES one NO -> False (non-unanimous).
+        p3 = create(service, "n3", 2, ConsensusConfig.gossipsub())
+        cast_remote_vote(service, "n3", p3.proposal_id, True, random_stub_signer())
+        cast_remote_vote(service, "n3", p3.proposal_id, False, random_stub_signer())
+        assert service.storage().get_consensus_result("n3", p3.proposal_id) is False
+
+    def test_n_gt_2_consensus_requirements(self):
+        service = make_service()
+        proposal = create(service, SCOPE, 3, ConsensusConfig.gossipsub())
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        with pytest.raises(ConsensusNotReached):
+            service.storage().get_consensus_result(SCOPE, proposal.proposal_id)
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        assert service.storage().get_consensus_result(SCOPE, proposal.proposal_id) is True
+
+
+class TestExpiryAndReplay:
+    def test_expired_proposal_rejected(self):
+        service = make_service()
+        request = CreateProposalRequest(
+            name="Expires",
+            payload=b"",
+            proposal_owner=random_stub_signer().identity(),
+            expected_voters_count=3,
+            expiration_timestamp=1,
+            liveness_criteria_yes=True,
+        )
+        proposal = service.create_proposal_with_config(
+            SCOPE, request, ConsensusConfig.gossipsub(), NOW
+        )
+        # 2 seconds later the proposal (1s lifetime) is expired.
+        with pytest.raises((ProposalExpired, VoteExpired)):
+            cast_remote_vote(
+                service, SCOPE, proposal.proposal_id, True, random_stub_signer(), now=NOW + 2
+            )
+
+    def test_timestamp_replay_attack_protection(self):
+        service = make_service()
+        proposal = create(service, SCOPE, 3, ConsensusConfig.gossipsub())
+        proposal = cast_remote_vote_and_get_proposal(
+            service, SCOPE, proposal.proposal_id, True, random_stub_signer()
+        )
+
+        voter = random_stub_signer()
+        vote = build_vote(proposal, True, voter, NOW)
+        # Rewind the timestamp to before proposal creation and re-sign.
+        vote.timestamp = NOW - EXPIRATION * 2
+        vote.vote_hash = compute_vote_hash(vote)
+        vote.signature = voter.sign(vote.signing_payload())
+
+        with pytest.raises(TimestampOlderThanCreationTime):
+            service.process_incoming_vote(SCOPE, vote, NOW)
+
+
+class TestEqualityOfVotes:
+    @pytest.mark.parametrize("liveness,expected", [(True, True), (False, False)])
+    def test_equality_resolved_by_liveness(self, liveness, expected):
+        service = make_service()
+        scope = f"equality_{liveness}"
+        proposal = create(service, scope, 4, ConsensusConfig.gossipsub(), liveness=liveness)
+        for choice in (True, True, False, False):
+            cast_remote_vote(service, scope, proposal.proposal_id, choice, random_stub_signer())
+        assert (
+            service.storage().get_consensus_result(scope, proposal.proposal_id) is expected
+        )

@@ -1,0 +1,138 @@
+"""Scope config builder/presets/validation (reference: tests/scope_config_tests.rs)."""
+
+import pytest
+
+from hashgraph_tpu import NetworkType, ScopeConfig, ScopeConfigBuilder
+from hashgraph_tpu.errors import (
+    InvalidConsensusThreshold,
+    InvalidMaxRounds,
+    InvalidTimeout,
+)
+from hashgraph_tpu.session import ConsensusConfig
+
+from common import make_service
+
+SCOPE = "scope_config_scope"
+
+
+class TestBuilder:
+    def test_defaults(self):
+        config = ScopeConfigBuilder().build()
+        assert config.network_type == NetworkType.GOSSIPSUB
+        assert config.default_consensus_threshold == 2.0 / 3.0
+        assert config.default_timeout == 60.0
+        assert config.default_liveness_criteria_yes is True
+        assert config.max_rounds_override is None
+
+    def test_fluent_fields(self):
+        config = (
+            ScopeConfigBuilder()
+            .with_network_type(NetworkType.P2P)
+            .with_threshold(0.8)
+            .with_timeout(90.0)
+            .with_liveness_criteria(False)
+            .with_max_rounds(5)
+            .build()
+        )
+        assert config.network_type == NetworkType.P2P
+        assert config.default_consensus_threshold == 0.8
+        assert config.default_timeout == 90.0
+        assert config.default_liveness_criteria_yes is False
+        assert config.max_rounds_override == 5
+
+    def test_presets(self):
+        p2p = ScopeConfigBuilder().p2p_preset().build()
+        assert p2p.network_type == NetworkType.P2P
+        gossip = ScopeConfigBuilder().gossipsub_preset().build()
+        assert gossip.network_type == NetworkType.GOSSIPSUB
+
+        strict = ScopeConfigBuilder().strict_consensus().build()
+        assert strict.default_consensus_threshold == 0.9
+        fast = ScopeConfigBuilder().fast_consensus().build()
+        assert fast.default_consensus_threshold == 0.6
+        assert fast.default_timeout == 30.0
+
+    def test_network_defaults_preserve_liveness_and_override(self):
+        """reference: src/scope_config.rs:173-187 — with_network_defaults resets
+        network/threshold/timeout but not liveness or max_rounds_override."""
+        config = (
+            ScopeConfigBuilder()
+            .with_liveness_criteria(False)
+            .with_max_rounds(9)
+            .with_network_defaults(NetworkType.P2P)
+            .build()
+        )
+        assert config.network_type == NetworkType.P2P
+        assert config.default_liveness_criteria_yes is False
+        assert config.max_rounds_override == 9
+
+    def test_validation(self):
+        with pytest.raises(InvalidConsensusThreshold):
+            ScopeConfigBuilder().with_threshold(1.5).build()
+        with pytest.raises(InvalidTimeout):
+            ScopeConfigBuilder().with_timeout(0).build()
+        # Some(0) override illegal for Gossipsub, legal for P2P.
+        with pytest.raises(InvalidMaxRounds):
+            ScopeConfigBuilder().with_max_rounds(0).build()
+        ScopeConfigBuilder().with_network_type(NetworkType.P2P).with_max_rounds(0).build()
+
+    def test_from_existing(self):
+        base = ScopeConfig(default_consensus_threshold=0.7)
+        updated = ScopeConfigBuilder.from_existing(base).with_timeout(10.0).build()
+        assert updated.default_consensus_threshold == 0.7
+        assert updated.default_timeout == 10.0
+        # Builder mutation does not alias the original.
+        assert base.default_timeout == 60.0
+
+
+class TestServiceScopeApi:
+    """reference: tests/scope_config_tests.rs init/update via the service."""
+
+    def test_initialize_and_update(self):
+        service = make_service()
+        service.scope(SCOPE).with_network_type(NetworkType.P2P).with_threshold(
+            0.75
+        ).with_timeout(120.0).initialize()
+
+        config = service.storage().get_scope_config(SCOPE)
+        assert config.network_type == NetworkType.P2P
+        assert config.default_consensus_threshold == 0.75
+
+        # Update a single field: existing values are the builder's base.
+        service.scope(SCOPE).with_threshold(0.8).update()
+        config = service.storage().get_scope_config(SCOPE)
+        assert config.default_consensus_threshold == 0.8
+        assert config.network_type == NetworkType.P2P  # preserved
+        assert config.default_timeout == 120.0  # preserved
+
+    def test_override_timeout_preserved_on_profile_update(self):
+        """reference: tests/scope_config_tests.rs:238-266"""
+        service = make_service()
+        service.scope(SCOPE).with_timeout(300.0).with_max_rounds(4).initialize()
+        service.scope(SCOPE).strict_consensus().update()
+        config = service.storage().get_scope_config(SCOPE)
+        assert config.default_consensus_threshold == 0.9
+        assert config.default_timeout == 300.0
+        assert config.max_rounds_override == 4
+
+    def test_get_config_reflects_pending_builder(self):
+        service = make_service()
+        wrapper = service.scope(SCOPE).with_threshold(0.77)
+        assert wrapper.get_config().default_consensus_threshold == 0.77
+        # not persisted until initialize()
+        assert service.storage().get_scope_config(SCOPE) is None
+
+    def test_scope_config_to_consensus_config_mapping(self):
+        """reference: src/session.rs:52-68"""
+        gossip = ConsensusConfig.from_scope_config(
+            ScopeConfig(network_type=NetworkType.GOSSIPSUB, max_rounds_override=None)
+        )
+        assert gossip.max_rounds == 2 and gossip.use_gossipsub_rounds
+        p2p = ConsensusConfig.from_scope_config(
+            ScopeConfig(network_type=NetworkType.P2P, max_rounds_override=None)
+        )
+        assert p2p.max_rounds == 0 and not p2p.use_gossipsub_rounds
+        override = ConsensusConfig.from_scope_config(
+            ScopeConfig(network_type=NetworkType.P2P, max_rounds_override=7)
+        )
+        assert override.max_rounds == 7

@@ -1,0 +1,386 @@
+"""Service-level behavior (reference: tests/consensus_service_tests.rs):
+happy paths, event emission, every timeout branch, rejections, idempotency,
+config resolution, query helpers, eviction, and scope lifecycle."""
+
+import pytest
+
+from hashgraph_tpu import (
+    ConsensusConfig,
+    ConsensusFailedEvent,
+    ConsensusReached,
+    CreateProposalRequest,
+    NetworkType,
+    build_vote,
+)
+from hashgraph_tpu.errors import (
+    ConsensusFailed,
+    ConsensusNotReached,
+    DuplicateVote,
+    InsufficientVotesAtTimeout,
+    ProposalAlreadyExist,
+    ProposalExpired,
+    SessionNotFound,
+    UserAlreadyVoted,
+)
+
+from common import (
+    NOW,
+    cast_remote_vote,
+    make_service,
+    random_stub_signer,
+    sibling_service,
+)
+
+SCOPE = "service_scope"
+EXPIRATION = 120
+
+
+def create(service, scope=SCOPE, n=3, config=None, liveness=True, now=NOW, expiration=EXPIRATION):
+    request = CreateProposalRequest(
+        name="Service Test",
+        payload=b"payload",
+        proposal_owner=service.signer().identity(),
+        expected_voters_count=n,
+        expiration_timestamp=expiration,
+        liveness_criteria_yes=liveness,
+    )
+    return service.create_proposal_with_config(
+        scope, request, config or ConsensusConfig.gossipsub(), now
+    )
+
+
+def drain_events(receiver):
+    events = []
+    while (item := receiver.try_recv()) is not None:
+        events.append(item)
+    return events
+
+
+class TestBasicFlow:
+    def test_create_cast_and_reach_consensus(self):
+        service = make_service()
+        proposal = create(service)
+        vote = service.cast_vote(SCOPE, proposal.proposal_id, True, NOW)
+        assert vote.vote_owner == service.signer().identity()
+        with pytest.raises(ConsensusNotReached):
+            service.storage().get_consensus_result(SCOPE, proposal.proposal_id)
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        assert service.storage().get_consensus_result(SCOPE, proposal.proposal_id) is True
+
+    def test_cast_vote_and_get_proposal_embeds_vote(self):
+        service = make_service()
+        proposal = create(service, n=5)
+        updated = service.cast_vote_and_get_proposal(SCOPE, proposal.proposal_id, True, NOW)
+        assert len(updated.votes) == 1
+        assert updated.votes[0].vote_owner == service.signer().identity()
+
+    def test_multi_scope_isolation(self):
+        service = make_service()
+        p1 = create(service, scope="scope_a")
+        p2 = create(service, scope="scope_b")
+        assert service.storage().get_session("scope_a", p2.proposal_id) is None
+        assert service.storage().get_session("scope_b", p1.proposal_id) is None
+        service.storage().delete_scope("scope_a")
+        assert service.storage().get_session("scope_a", p1.proposal_id) is None
+        assert service.storage().get_session("scope_b", p2.proposal_id) is not None
+
+    def test_process_incoming_proposal_roundtrip(self):
+        origin = make_service()
+        proposal = create(origin, n=5)
+        origin.cast_vote(SCOPE, proposal.proposal_id, True, NOW)
+        snapshot = origin.storage().get_proposal(SCOPE, proposal.proposal_id)
+
+        receiver_service = make_service()
+        receiver_service.process_incoming_proposal(SCOPE, snapshot.clone(), NOW)
+        stored = receiver_service.storage().get_proposal(SCOPE, proposal.proposal_id)
+        assert len(stored.votes) == 1
+        assert stored.round == 2
+
+
+class TestEvents:
+    def test_consensus_reached_event_emitted(self):
+        service = make_service()
+        receiver = service.event_bus().subscribe()
+        proposal = create(service)
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        events = drain_events(receiver)
+        assert (SCOPE, ConsensusReached(proposal.proposal_id, True, NOW)) in events
+
+    def test_no_event_until_consensus(self):
+        service = make_service()
+        receiver = service.event_bus().subscribe()
+        proposal = create(service, n=5)
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        assert drain_events(receiver) == []
+
+    def test_failed_event_on_timeout(self):
+        service = make_service()
+        receiver = service.event_bus().subscribe()
+        proposal = create(service, n=4, liveness=True)
+        # 1 YES, 2 NO, 1 silent-as-YES -> weighted tie -> Failed.
+        for choice in (True, False, False):
+            cast_remote_vote(service, SCOPE, proposal.proposal_id, choice, random_stub_signer())
+        with pytest.raises(InsufficientVotesAtTimeout):
+            service.handle_consensus_timeout(SCOPE, proposal.proposal_id, NOW + 60)
+        events = drain_events(receiver)
+        assert (SCOPE, ConsensusFailedEvent(proposal.proposal_id, NOW + 60)) in events
+
+
+class TestTimeoutBranches:
+    """reference: tests/consensus_service_tests.rs:303-843"""
+
+    def test_timeout_already_reached_is_idempotent(self):
+        service = make_service()
+        proposal = create(service)
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        assert service.handle_consensus_timeout(SCOPE, proposal.proposal_id, NOW + 60) is True
+        # Second call returns the same result (reference: :1219-1281).
+        assert service.handle_consensus_timeout(SCOPE, proposal.proposal_id, NOW + 61) is True
+
+    def test_reach_yes_at_timeout_quorum_gate(self):
+        # n=4, 2 YES before timeout: no quorum (2 < 3); at timeout the gate
+        # opens and silent-as-YES pushes YES through.
+        service = make_service()
+        proposal = create(service, n=4, liveness=True)
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        with pytest.raises(ConsensusNotReached):
+            service.storage().get_consensus_result(SCOPE, proposal.proposal_id)
+        assert service.handle_consensus_timeout(SCOPE, proposal.proposal_id, NOW + 60) is True
+
+    def test_no_result_at_timeout(self):
+        # n=4, liveness=False: 2 YES + 2 silent-as-NO -> weighted tie, total<n -> None.
+        service = make_service()
+        proposal = create(service, n=4, liveness=False)
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        with pytest.raises(InsufficientVotesAtTimeout):
+            service.handle_consensus_timeout(SCOPE, proposal.proposal_id, NOW + 60)
+        with pytest.raises(ConsensusFailed):
+            service.storage().get_consensus_result(SCOPE, proposal.proposal_id)
+
+    def test_liveness_no_majority(self):
+        # n=4, liveness=False: 1 YES, 1 NO, 2 silent-as-NO -> 3 NO >= 3 -> NO.
+        service = make_service()
+        proposal = create(service, n=4, liveness=False)
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, False, random_stub_signer())
+        assert service.handle_consensus_timeout(SCOPE, proposal.proposal_id, NOW + 60) is False
+
+    def test_zero_votes_timeout_liveness_yes(self):
+        # All silent, liveness=True: yes_weight = n >= required -> YES.
+        service = make_service()
+        proposal = create(service, n=4, liveness=True)
+        assert service.handle_consensus_timeout(SCOPE, proposal.proposal_id, NOW + 60) is True
+
+    def test_zero_votes_timeout_liveness_no(self):
+        service = make_service()
+        proposal = create(service, n=4, liveness=False)
+        assert service.handle_consensus_timeout(SCOPE, proposal.proposal_id, NOW + 60) is False
+
+    def test_p2p_timeout_variant(self):
+        service = make_service()
+        proposal = create(service, n=4, config=ConsensusConfig.p2p(), liveness=True)
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, random_stub_signer())
+        assert service.handle_consensus_timeout(SCOPE, proposal.proposal_id, NOW + 60) is True
+
+    def test_timeout_unknown_proposal(self):
+        service = make_service()
+        with pytest.raises(SessionNotFound):
+            service.handle_consensus_timeout(SCOPE, 999, NOW)
+
+
+class TestRejections:
+    def test_user_already_voted_via_cast(self):
+        service = make_service()
+        proposal = create(service, n=5)
+        service.cast_vote(SCOPE, proposal.proposal_id, True, NOW)
+        with pytest.raises(UserAlreadyVoted):
+            service.cast_vote(SCOPE, proposal.proposal_id, False, NOW)
+
+    def test_duplicate_incoming_vote(self):
+        service = make_service()
+        proposal = create(service, n=5)
+        voter = random_stub_signer()
+        cast_remote_vote(service, SCOPE, proposal.proposal_id, True, voter)
+        snapshot = service.storage().get_proposal(SCOPE, proposal.proposal_id)
+        dup = build_vote(snapshot, False, voter, NOW)
+        with pytest.raises(DuplicateVote):
+            service.process_incoming_vote(SCOPE, dup, NOW)
+
+    def test_unknown_proposal_vote(self):
+        service = make_service()
+        create(service, n=5)
+        orphan = build_vote(
+            CreateProposalRequest(
+                name="x",
+                payload=b"",
+                proposal_owner=b"o",
+                expected_voters_count=3,
+                expiration_timestamp=60,
+                liveness_criteria_yes=True,
+            ).into_proposal(NOW),
+            True,
+            random_stub_signer(),
+            NOW,
+        )
+        with pytest.raises(SessionNotFound):
+            service.process_incoming_vote(SCOPE, orphan, NOW)
+
+    def test_duplicate_proposal(self):
+        service = make_service()
+        proposal = create(service, n=5)
+        snapshot = service.storage().get_proposal(SCOPE, proposal.proposal_id)
+        with pytest.raises(ProposalAlreadyExist):
+            service.process_incoming_proposal(SCOPE, snapshot, NOW)
+
+    def test_cast_on_expired_proposal(self):
+        service = make_service()
+        proposal = create(service, expiration=10)
+        with pytest.raises(ProposalExpired):
+            service.cast_vote(SCOPE, proposal.proposal_id, True, NOW + 11)
+
+    def test_expired_incoming_proposal(self):
+        origin = make_service()
+        proposal = create(origin, expiration=10)
+        snapshot = origin.storage().get_proposal(SCOPE, proposal.proposal_id)
+        receiver = make_service()
+        with pytest.raises(ProposalExpired):
+            receiver.process_incoming_proposal(SCOPE, snapshot, NOW + 11)
+
+
+class TestConfigResolution:
+    """reference: tests/consensus_service_tests.rs:1332-1377 + src/service.rs:444-484"""
+
+    def test_scope_config_used_when_no_override(self):
+        service = make_service()
+        service.scope(SCOPE).with_network_type(NetworkType.P2P).with_threshold(0.75).initialize()
+        request = CreateProposalRequest(
+            name="x",
+            payload=b"",
+            proposal_owner=service.signer().identity(),
+            expected_voters_count=4,
+            expiration_timestamp=EXPIRATION,
+            liveness_criteria_yes=True,
+        )
+        proposal = service.create_proposal(SCOPE, request, NOW)
+        config = service.storage().get_proposal_config(SCOPE, proposal.proposal_id)
+        assert config.consensus_threshold == 0.75
+        assert not config.use_gossipsub_rounds
+
+    def test_gossipsub_default_without_scope_config(self):
+        service = make_service()
+        request = CreateProposalRequest(
+            name="x",
+            payload=b"",
+            proposal_owner=service.signer().identity(),
+            expected_voters_count=4,
+            expiration_timestamp=EXPIRATION,
+            liveness_criteria_yes=True,
+        )
+        proposal = service.create_proposal(SCOPE, request, NOW)
+        config = service.storage().get_proposal_config(SCOPE, proposal.proposal_id)
+        assert config.use_gossipsub_rounds
+        assert config.consensus_threshold == 2.0 / 3.0
+        # Timeout derived from the proposal's expiration window.
+        assert config.consensus_timeout == float(EXPIRATION)
+
+    def test_explicit_override_keeps_its_timeout(self):
+        service = make_service()
+        override = ConsensusConfig.gossipsub().with_timeout(7.0)
+        proposal = create(service, config=override)
+        config = service.storage().get_proposal_config(SCOPE, proposal.proposal_id)
+        assert config.consensus_timeout == 7.0
+
+    def test_liveness_always_from_proposal(self):
+        service = make_service()
+        override = ConsensusConfig.gossipsub().with_liveness_criteria(True)
+        proposal = create(service, config=override, liveness=False)
+        config = service.storage().get_proposal_config(SCOPE, proposal.proposal_id)
+        assert config.liveness_criteria is False
+
+
+class TestQueryHelpers:
+    """reference: tests/consensus_service_tests.rs:1380-1629"""
+
+    def test_get_proposal_and_errors(self):
+        service = make_service()
+        proposal = create(service)
+        assert (
+            service.storage().get_proposal(SCOPE, proposal.proposal_id).proposal_id
+            == proposal.proposal_id
+        )
+        with pytest.raises(SessionNotFound):
+            service.storage().get_proposal(SCOPE, 12345678)
+        with pytest.raises(SessionNotFound):
+            service.storage().get_consensus_result(SCOPE, 12345678)
+        with pytest.raises(SessionNotFound):
+            service.storage().get_proposal_config(SCOPE, 12345678)
+
+    def test_get_active_and_reached_proposals(self):
+        service = make_service()
+        p_active = create(service, n=5)
+        p_reached = create(service, n=1)
+        cast_remote_vote(service, SCOPE, p_reached.proposal_id, True, random_stub_signer())
+
+        active_ids = {p.proposal_id for p in service.storage().get_active_proposals(SCOPE)}
+        assert p_active.proposal_id in active_ids
+        assert p_reached.proposal_id not in active_ids
+
+        reached = service.storage().get_reached_proposals(SCOPE)
+        assert reached == {p_reached.proposal_id: True}
+
+    def test_helpers_on_unknown_scope(self):
+        service = make_service()
+        assert service.storage().get_active_proposals("nope") == []
+        assert service.storage().get_reached_proposals("nope") == {}
+
+    def test_stats(self):
+        service = make_service()
+        p1 = create(service, n=5)
+        p2 = create(service, n=1)
+        cast_remote_vote(service, SCOPE, p2.proposal_id, True, random_stub_signer())
+        p3 = create(service, n=4, liveness=False)
+        cast_remote_vote(service, SCOPE, p3.proposal_id, True, random_stub_signer())
+        cast_remote_vote(service, SCOPE, p3.proposal_id, True, random_stub_signer())
+        with pytest.raises(InsufficientVotesAtTimeout):
+            service.handle_consensus_timeout(SCOPE, p3.proposal_id, NOW + 60)
+
+        stats = service.get_scope_stats(SCOPE)
+        assert stats.total_sessions == 3
+        assert stats.active_sessions == 1
+        assert stats.consensus_reached == 1
+        assert stats.failed_sessions == 1
+
+        empty = service.get_scope_stats("unknown_scope")
+        assert empty.total_sessions == 0
+
+    def test_delete_scope_lifecycle(self):
+        """reference: tests/consensus_service_tests.rs:1632-1735"""
+        service = make_service()
+        service.scope(SCOPE).with_threshold(0.9).initialize()
+        proposal = create(service)
+        service.storage().delete_scope(SCOPE)
+        assert service.storage().get_session(SCOPE, proposal.proposal_id) is None
+        assert service.storage().get_scope_config(SCOPE) is None
+        # Scope behaves as never-initialized: new proposals start fresh.
+        p2 = create(service)
+        config = service.storage().get_proposal_config(SCOPE, p2.proposal_id)
+        assert config.consensus_threshold == 2.0 / 3.0
+
+
+class TestEviction:
+    def test_trim_scope_sessions_keeps_newest(self):
+        """reference: src/service.rs:512-522 — silent LRU-by-created_at."""
+        service = make_service(max_sessions=3)
+        kept = []
+        for i in range(5):
+            proposal = create(service, now=NOW + i)
+            kept.append((proposal.proposal_id, NOW + i))
+        sessions = service.storage().list_scope_sessions(SCOPE)
+        assert len(sessions) == 3
+        surviving = {s.proposal.proposal_id for s in sessions}
+        expected = {pid for pid, ts in sorted(kept, key=lambda x: -x[1])[:3]}
+        assert surviving == expected

@@ -1,0 +1,214 @@
+"""Session engine tests (reference: src/session.rs:407-700 inline tests)."""
+
+import pytest
+
+from hashgraph_tpu import (
+    ConsensusConfig,
+    CreateProposalRequest,
+    build_vote,
+)
+from hashgraph_tpu.errors import (
+    DuplicateVote,
+    InvalidConsensusThreshold,
+    InvalidTimeout,
+    MaxRoundsExceeded,
+    SessionNotActive,
+)
+from hashgraph_tpu.session import ConsensusSession, ConsensusState
+from hashgraph_tpu.signing import StubConsensusSigner
+
+from common import NOW
+
+U32_MAX = 0xFFFFFFFF
+
+
+def signer(tag: bytes) -> StubConsensusSigner:
+    return StubConsensusSigner(tag.ljust(20, b"\x00"))
+
+
+def fresh_session(n: int, config: ConsensusConfig, liveness=False) -> ConsensusSession:
+    request = CreateProposalRequest(
+        name="Test",
+        payload=b"",
+        proposal_owner=signer(b"owner").identity(),
+        expected_voters_count=n,
+        expiration_timestamp=60,
+        liveness_criteria_yes=liveness,
+    )
+    proposal = request.into_proposal(NOW)
+    return ConsensusSession._new(proposal, config, NOW)
+
+
+class TestRoundLimits:
+    def test_enforce_max_rounds_gossipsub(self):
+        """reference: src/session.rs:426-469 — 4 votes all live in round 2."""
+        session = fresh_session(4, ConsensusConfig.gossipsub())
+        for i, choice in enumerate([True, False, True, True]):
+            vote = build_vote(session.proposal, choice, signer(b"v%d" % i), NOW)
+            session.add_vote(vote, NOW)
+            assert session.proposal.round == 2
+        assert len(session.votes) == 4
+
+    def test_enforce_max_rounds_p2p(self):
+        """reference: src/session.rs:471-524 — n=5: cap ceil(2n/3)=4 votes,
+        5th vote fails with MaxRoundsExceeded."""
+        session = fresh_session(5, ConsensusConfig.p2p())
+        for i, choice in enumerate([True, False, True, True]):
+            vote = build_vote(session.proposal, choice, signer(b"v%d" % i), NOW)
+            session.add_vote(vote, NOW)
+            assert session.proposal.round == i + 2
+            assert len(session.votes) == i + 1
+        vote5 = build_vote(session.proposal, True, signer(b"v5"), NOW)
+        with pytest.raises(MaxRoundsExceeded):
+            session.add_vote(vote5, NOW)
+        assert session.state.is_failed
+
+    def test_explicit_max_rounds_overrides_dynamic(self):
+        """reference: src/session.rs:546-552"""
+        explicit = ConsensusConfig(
+            consensus_threshold=2.0 / 3.0,
+            consensus_timeout=60.0,
+            max_rounds=7,
+            use_gossipsub_rounds=False,
+            liveness_criteria=True,
+        )
+        assert explicit.max_round_limit(100) == 7
+
+    def test_huge_vote_count_rejected(self):
+        """reference: src/session.rs:639-668 — a batch larger than u32::MAX
+        votes must be rejected by round-limit checks."""
+        session = fresh_session(1, ConsensusConfig.p2p())
+        with pytest.raises(MaxRoundsExceeded):
+            session._check_round_limit(U32_MAX + 1)
+        assert session.state.is_failed
+
+    def test_update_round_saturates_at_u32_max(self):
+        """reference: src/session.rs:670-699"""
+        session = fresh_session(U32_MAX, ConsensusConfig.p2p())
+        start = session.proposal.round
+        session._update_round(U32_MAX)
+        assert session.proposal.round > start
+        assert session.proposal.round == U32_MAX
+
+    def test_gossipsub_zero_votes_round_projection(self):
+        """reference: src/session.rs:630-633 — vote_count=0 at round 1 passes."""
+        session = fresh_session(4, ConsensusConfig.gossipsub())
+        session._check_round_limit(0)
+        assert session.proposal.round == 1
+
+
+class TestConfigBuilder:
+    def test_builder_and_getters(self):
+        """reference: src/session.rs:526-553"""
+        cfg = (
+            ConsensusConfig.gossipsub()
+            .with_threshold(0.75)
+            .with_timeout(42.0)
+            .with_liveness_criteria(False)
+        )
+        assert cfg.consensus_threshold == 0.75
+        assert cfg.consensus_timeout == 42.0
+        assert cfg.liveness_criteria is False
+
+        with pytest.raises(InvalidConsensusThreshold):
+            ConsensusConfig.gossipsub().with_threshold(1.1)
+        with pytest.raises(InvalidTimeout):
+            ConsensusConfig.gossipsub().with_timeout(0)
+
+    def test_presets(self):
+        g = ConsensusConfig.gossipsub()
+        assert g.max_rounds == 2 and g.use_gossipsub_rounds
+        p = ConsensusConfig.p2p()
+        assert p.max_rounds == 0 and not p.use_gossipsub_rounds
+        # dynamic limit for p2p
+        assert p.max_round_limit(9) == 6
+
+
+class TestStateMachine:
+    def test_failed_session_rejects_votes(self):
+        """reference: src/session.rs:555-592"""
+        session = fresh_session(3, ConsensusConfig.gossipsub(), liveness=True)
+        session.state = ConsensusState.failed()
+        vote = build_vote(session.proposal, True, signer(b"a"), NOW)
+        with pytest.raises(SessionNotActive):
+            session.add_vote(vote, NOW)
+
+    def test_finalized_session_reports_reached(self):
+        session = fresh_session(3, ConsensusConfig.gossipsub(), liveness=True)
+        session.state = ConsensusState.reached(True)
+        vote = build_vote(session.proposal, True, signer(b"a"), NOW)
+        transition = session.add_vote(vote, NOW)
+        assert transition.is_reached and transition.reached is True
+        assert len(session.votes) == 0  # not inserted
+
+    def test_initialize_non_active_rejected(self):
+        """reference: src/session.rs:594-637"""
+        session = fresh_session(4, ConsensusConfig.gossipsub(), liveness=True)
+        session.state = ConsensusState.failed()
+        with pytest.raises(SessionNotActive):
+            session.initialize_with_votes(
+                [],
+                StubConsensusSigner,
+                session.proposal.expiration_timestamp,
+                session.proposal.timestamp,
+                NOW,
+            )
+
+    def test_initialize_duplicate_owner_rejected(self):
+        session = fresh_session(4, ConsensusConfig.gossipsub(), liveness=True)
+        s = signer(b"dup")
+        v1 = build_vote(session.proposal, True, s, NOW)
+        v2 = build_vote(session.proposal, False, s, NOW)
+        with pytest.raises(DuplicateVote):
+            session.initialize_with_votes(
+                [v1, v2],
+                StubConsensusSigner,
+                session.proposal.expiration_timestamp,
+                session.proposal.timestamp,
+                NOW,
+            )
+
+    def test_initialize_batch_larger_than_n_fails_session(self):
+        """reference: src/session.rs:277-282"""
+        session = fresh_session(2, ConsensusConfig.gossipsub(), liveness=True)
+        votes = []
+        proposal = session.proposal.clone()
+        for i in range(3):
+            v = build_vote(proposal, True, signer(b"v%d" % i), NOW)
+            proposal.votes.append(v)
+            votes.append(v)
+        with pytest.raises(MaxRoundsExceeded):
+            session.initialize_with_votes(
+                votes,
+                StubConsensusSigner,
+                session.proposal.expiration_timestamp,
+                session.proposal.timestamp,
+                NOW,
+            )
+        assert session.state.is_failed
+
+    def test_consensus_reached_via_add_vote(self):
+        session = fresh_session(3, ConsensusConfig.gossipsub(), liveness=True)
+        v1 = build_vote(session.proposal, True, signer(b"a"), NOW)
+        t1 = session.add_vote(v1, NOW)
+        assert not t1.is_reached
+        v2 = build_vote(session.proposal, True, signer(b"b"), NOW)
+        t2 = session.add_vote(v2, NOW)
+        # 2 YES of n=3: quorum 2 met, yes_weight=2+1(silent,liveness)=3 > no=0
+        assert t2.is_reached and t2.reached is True
+        assert session.get_consensus_result() is True
+
+    def test_from_proposal_replays_votes(self):
+        """reference: src/session.rs:198-221 — embedded votes replayed from a
+        clean round-1 state."""
+        origin = fresh_session(3, ConsensusConfig.gossipsub(), liveness=True)
+        for tag in (b"a", b"b"):
+            v = build_vote(origin.proposal, True, signer(tag), NOW)
+            origin.add_vote(v, NOW)
+
+        session, transition = ConsensusSession.from_proposal(
+            origin.proposal.clone(), StubConsensusSigner, ConsensusConfig.gossipsub(), NOW
+        )
+        assert transition.is_reached and transition.reached is True
+        assert len(session.votes) == 2
+        assert session.proposal.round == 2

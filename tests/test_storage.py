@@ -1,0 +1,200 @@
+"""Storage-contract tests (reference: tests/storage_stream_tests.rs):
+stream/list/remove/replace, update error paths, empty-scope cleanup, and
+scope-config validation paths."""
+
+import pytest
+
+from hashgraph_tpu import (
+    ConsensusConfig,
+    CreateProposalRequest,
+    InMemoryConsensusStorage,
+    NetworkType,
+    ScopeConfig,
+)
+from hashgraph_tpu.errors import (
+    InvalidConsensusThreshold,
+    InvalidMaxRounds,
+    SessionNotFound,
+)
+from hashgraph_tpu.session import ConsensusSession
+
+from common import NOW, make_service, random_stub_signer
+
+SCOPE = "storage_scope"
+
+
+def make_session(n=3, now=NOW) -> ConsensusSession:
+    request = CreateProposalRequest(
+        name="S",
+        payload=b"",
+        proposal_owner=random_stub_signer().identity(),
+        expected_voters_count=n,
+        expiration_timestamp=120,
+        liveness_criteria_yes=True,
+    )
+    proposal = request.into_proposal(now)
+    return ConsensusSession._new(proposal, ConsensusConfig.gossipsub(), now)
+
+
+class TestSessionPrimitives:
+    def test_save_get_remove(self):
+        storage = InMemoryConsensusStorage()
+        session = make_session()
+        pid = session.proposal.proposal_id
+        storage.save_session(SCOPE, session)
+        assert storage.get_session(SCOPE, pid).proposal.proposal_id == pid
+        removed = storage.remove_session(SCOPE, pid)
+        assert removed.proposal.proposal_id == pid
+        assert storage.get_session(SCOPE, pid) is None
+        assert storage.remove_session(SCOPE, pid) is None
+        assert storage.remove_session("ghost", 1) is None
+
+    def test_get_returns_snapshot_not_alias(self):
+        storage = InMemoryConsensusStorage()
+        session = make_session()
+        pid = session.proposal.proposal_id
+        storage.save_session(SCOPE, session)
+        snapshot = storage.get_session(SCOPE, pid)
+        snapshot.proposal.name = "mutated"
+        assert storage.get_session(SCOPE, pid).proposal.name == "S"
+
+    def test_list_and_stream(self):
+        """reference: tests/storage_stream_tests.rs:42-127"""
+        storage = InMemoryConsensusStorage()
+        assert storage.list_scope_sessions(SCOPE) is None
+        sessions = [make_session() for _ in range(3)]
+        for s in sessions:
+            storage.save_session(SCOPE, s)
+        listed = storage.list_scope_sessions(SCOPE)
+        assert {s.proposal.proposal_id for s in listed} == {
+            s.proposal.proposal_id for s in sessions
+        }
+        streamed = list(storage.stream_scope_sessions(SCOPE))
+        assert len(streamed) == 3
+        assert list(storage.stream_scope_sessions("ghost")) == []
+
+    def test_replace_scope_sessions(self):
+        storage = InMemoryConsensusStorage()
+        storage.save_session(SCOPE, make_session())
+        replacement = [make_session(), make_session()]
+        storage.replace_scope_sessions(SCOPE, replacement)
+        listed = storage.list_scope_sessions(SCOPE)
+        assert {s.proposal.proposal_id for s in listed} == {
+            s.proposal.proposal_id for s in replacement
+        }
+
+    def test_list_scopes(self):
+        storage = InMemoryConsensusStorage()
+        assert storage.list_scopes() is None
+        storage.save_session("a", make_session())
+        storage.save_session("b", make_session())
+        assert set(storage.list_scopes()) == {"a", "b"}
+
+    def test_update_session_not_found(self):
+        """reference: tests/storage_stream_tests.rs:130-181"""
+        storage = InMemoryConsensusStorage()
+        with pytest.raises(SessionNotFound):
+            storage.update_session(SCOPE, 42, lambda s: None)
+
+    def test_update_session_mutation_persists_even_on_error(self):
+        # Mirrors the reference: the mutator runs on the stored value, so
+        # state changes made before an error stick (Failed-on-cap semantics).
+        storage = InMemoryConsensusStorage()
+        session = make_session()
+        pid = session.proposal.proposal_id
+        storage.save_session(SCOPE, session)
+
+        def mutator(s):
+            s.proposal.name = "touched"
+            raise ValueError("boom")
+
+        with pytest.raises(ValueError):
+            storage.update_session(SCOPE, pid, mutator)
+        assert storage.get_session(SCOPE, pid).proposal.name == "touched"
+
+    def test_update_scope_sessions_empty_removes_scope(self):
+        storage = InMemoryConsensusStorage()
+        storage.save_session(SCOPE, make_session())
+
+        storage.update_scope_sessions(SCOPE, lambda sessions: sessions.clear())
+        assert storage.list_scope_sessions(SCOPE) is None
+        assert storage.list_scopes() is None
+
+
+class TestScopeConfigStorage:
+    """reference: tests/storage_stream_tests.rs:184-244"""
+
+    def test_get_set_roundtrip(self):
+        storage = InMemoryConsensusStorage()
+        assert storage.get_scope_config(SCOPE) is None
+        config = ScopeConfig(network_type=NetworkType.P2P, default_consensus_threshold=0.8)
+        storage.set_scope_config(SCOPE, config)
+        loaded = storage.get_scope_config(SCOPE)
+        assert loaded.network_type == NetworkType.P2P
+        assert loaded.default_consensus_threshold == 0.8
+        # returned config is a snapshot
+        loaded.default_consensus_threshold = 0.1
+        assert storage.get_scope_config(SCOPE).default_consensus_threshold == 0.8
+
+    def test_set_invalid_config_rejected(self):
+        storage = InMemoryConsensusStorage()
+        bad = ScopeConfig(default_consensus_threshold=1.5)
+        with pytest.raises(InvalidConsensusThreshold):
+            storage.set_scope_config(SCOPE, bad)
+        assert storage.get_scope_config(SCOPE) is None
+
+    def test_update_creates_default_then_validates(self):
+        storage = InMemoryConsensusStorage()
+
+        def updater(config):
+            config.default_consensus_threshold = 0.9
+
+        storage.update_scope_config(SCOPE, updater)
+        assert storage.get_scope_config(SCOPE).default_consensus_threshold == 0.9
+
+        def bad_updater(config):
+            config.max_rounds_override = 0  # illegal for Gossipsub
+
+        with pytest.raises(InvalidMaxRounds):
+            storage.update_scope_config(SCOPE, bad_updater)
+
+    def test_delete_scope_clears_config_and_sessions(self):
+        storage = InMemoryConsensusStorage()
+        storage.save_session(SCOPE, make_session())
+        storage.set_scope_config(SCOPE, ScopeConfig())
+        storage.delete_scope(SCOPE)
+        assert storage.list_scope_sessions(SCOPE) is None
+        assert storage.get_scope_config(SCOPE) is None
+
+
+class TestCustomStorageBackend:
+    """The service is storage-agnostic: a dict-backed toy implementation
+    satisfying the contract works end-to-end (role analogous to
+    reference: tests/custom_scheme_tests.rs for the signer axis)."""
+
+    def test_service_over_custom_storage(self):
+        class TracingStorage(InMemoryConsensusStorage):
+            def __init__(self):
+                super().__init__()
+                self.saves = 0
+
+            def save_session(self, scope, session):
+                self.saves += 1
+                return super().save_session(scope, session)
+
+        storage = TracingStorage()
+        from hashgraph_tpu import BroadcastEventBus, ConsensusService
+
+        service = ConsensusService(storage, BroadcastEventBus(), random_stub_signer())
+        request = CreateProposalRequest(
+            name="x",
+            payload=b"",
+            proposal_owner=service.signer().identity(),
+            expected_voters_count=1,
+            expiration_timestamp=60,
+            liveness_criteria_yes=True,
+        )
+        proposal = service.create_proposal(SCOPE, request, NOW)
+        service.cast_vote(SCOPE, proposal.proposal_id, True, NOW)
+        assert storage.saves == 1
+        assert storage.get_consensus_result(SCOPE, proposal.proposal_id) is True

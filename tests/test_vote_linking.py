@@ -1,0 +1,63 @@
+"""Hashgraph linking rules (reference: tests/vote_tests.rs)."""
+
+from hashgraph_tpu import (
+    ConsensusConfig,
+    ConsensusService,
+    BroadcastEventBus,
+    CreateProposalRequest,
+    EthereumConsensusSigner,
+    InMemoryConsensusStorage,
+    build_vote,
+    validate_proposal,
+)
+
+from common import NOW
+
+SCOPE = "vote_scope"
+
+
+def make_owner_service():
+    owner = EthereumConsensusSigner.random()
+    service = ConsensusService(InMemoryConsensusStorage(), BroadcastEventBus(), owner)
+    request = CreateProposalRequest(
+        name="Vote Test Proposal",
+        payload=b"",
+        proposal_owner=owner.identity(),
+        expected_voters_count=3,
+        expiration_timestamp=120,
+        liveness_criteria_yes=True,
+    )
+    proposal = service.create_proposal_with_config(
+        SCOPE, request, ConsensusConfig.gossipsub(), NOW
+    )
+    proposal = service.cast_vote_and_get_proposal(SCOPE, proposal.proposal_id, True, NOW)
+    return service, owner, proposal
+
+
+def test_received_hash_for_new_voter():
+    """reference: tests/vote_tests.rs:26-68 — a new voter has empty parent and
+    received = latest vote's hash."""
+    _, _, proposal = make_owner_service()
+    other_voter = EthereumConsensusSigner.random()
+    vote = build_vote(proposal, True, other_voter, NOW)
+
+    assert vote.parent_hash == b""
+    assert vote.received_hash == proposal.votes[0].vote_hash
+
+    with_vote = proposal.clone()
+    with_vote.votes.append(vote)
+    validate_proposal(with_vote, EthereumConsensusSigner, NOW)
+
+
+def test_parent_hash_for_same_voter():
+    """reference: tests/vote_tests.rs:71-114 — the same voter's second vote
+    chains parent to their prior vote."""
+    _, owner, proposal = make_owner_service()
+    second_vote = build_vote(proposal, False, owner, NOW)
+
+    assert second_vote.received_hash == proposal.votes[0].vote_hash
+    assert second_vote.parent_hash == proposal.votes[0].vote_hash
+
+    with_vote = proposal.clone()
+    with_vote.votes.append(second_vote)
+    validate_proposal(with_vote, EthereumConsensusSigner, NOW)

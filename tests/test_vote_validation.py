@@ -1,0 +1,148 @@
+"""Adversarial vote-mutation tests with the real ECDSA scheme
+(reference: tests/vote_validation_tests.rs)."""
+
+import pytest
+
+from hashgraph_tpu import (
+    ConsensusConfig,
+    CreateProposalRequest,
+    EthereumConsensusSigner,
+    build_vote,
+    compute_vote_hash,
+    validate_proposal,
+)
+from hashgraph_tpu.errors import (
+    ConsensusSchemeError,
+    EmptySignature,
+    EmptyVoteHash,
+    EmptyVoteOwner,
+    InvalidVoteSignature,
+    ParentHashMismatch,
+    ReceivedHashMismatch,
+)
+
+from common import NOW, cast_remote_vote_and_get_proposal, make_service
+
+SCOPE = "validation_scope"
+EXPIRATION = 120
+
+
+def resign_vote(vote, signer: EthereumConsensusSigner):
+    """Re-hash and re-sign after tampering (reference: tests/vote_validation_tests.rs:29-41)."""
+    vote.vote_hash = compute_vote_hash(vote)
+    vote.signature = signer.sign(vote.signing_payload())
+
+
+@pytest.fixture()
+def eth_setup():
+    service = make_service(scheme="ethereum")
+    owner = EthereumConsensusSigner.random()
+    request = CreateProposalRequest(
+        name="Proposal",
+        payload=b"",
+        proposal_owner=owner.identity(),
+        expected_voters_count=3,
+        expiration_timestamp=EXPIRATION,
+        liveness_criteria_yes=True,
+    )
+    proposal = service.create_proposal_with_config(
+        SCOPE, request, ConsensusConfig.gossipsub(), NOW
+    )
+    proposal = cast_remote_vote_and_get_proposal(
+        service, SCOPE, proposal.proposal_id, True, owner
+    )
+    return service, proposal
+
+
+def test_vote_created_with_helper_is_valid(eth_setup):
+    service, proposal = eth_setup
+    vote = build_vote(proposal, True, EthereumConsensusSigner.random(), NOW)
+    service.process_incoming_vote(SCOPE, vote, NOW)
+
+
+def test_invalid_signature_is_rejected(eth_setup):
+    _, proposal = eth_setup
+    voter = EthereumConsensusSigner.random()
+    vote = build_vote(proposal, True, voter, NOW)
+    wrong_signer = EthereumConsensusSigner.random()
+    vote.signature = wrong_signer.sign(vote.signing_payload())
+
+    invalid = proposal.clone()
+    invalid.votes.append(vote)
+    with pytest.raises(InvalidVoteSignature):
+        validate_proposal(invalid, EthereumConsensusSigner, NOW)
+
+
+def test_vote_chain_rejects_bad_received_hash(eth_setup):
+    _, proposal = eth_setup
+    vote_one = build_vote(proposal, True, EthereumConsensusSigner.random(), NOW)
+    voter_two = EthereumConsensusSigner.random()
+    vote_two = build_vote(proposal, False, voter_two, NOW)
+    vote_two.received_hash = b"\x00" * 32
+    resign_vote(vote_two, voter_two)
+
+    invalid = proposal.clone()
+    invalid.votes.extend([vote_one, vote_two])
+    with pytest.raises(ReceivedHashMismatch):
+        validate_proposal(invalid, EthereumConsensusSigner, NOW)
+
+
+def test_rejects_empty_vote_owner(eth_setup):
+    _, proposal = eth_setup
+    vote = build_vote(proposal, True, EthereumConsensusSigner.random(), NOW)
+    vote.vote_owner = b""
+    invalid = proposal.clone()
+    invalid.votes.append(vote)
+    with pytest.raises(EmptyVoteOwner):
+        validate_proposal(invalid, EthereumConsensusSigner, NOW)
+
+
+def test_rejects_empty_vote_hash(eth_setup):
+    _, proposal = eth_setup
+    vote = build_vote(proposal, True, EthereumConsensusSigner.random(), NOW)
+    vote.vote_hash = b""
+    invalid = proposal.clone()
+    invalid.votes.append(vote)
+    with pytest.raises(EmptyVoteHash):
+        validate_proposal(invalid, EthereumConsensusSigner, NOW)
+
+
+def test_rejects_empty_signature(eth_setup):
+    _, proposal = eth_setup
+    vote = build_vote(proposal, True, EthereumConsensusSigner.random(), NOW)
+    vote.signature = b""
+    invalid = proposal.clone()
+    invalid.votes.append(vote)
+    with pytest.raises(EmptySignature):
+        validate_proposal(invalid, EthereumConsensusSigner, NOW)
+
+
+def test_rejects_mismatched_signature_length(eth_setup):
+    """Length checks live in the scheme and surface as scheme errors
+    (reference: tests/vote_validation_tests.rs:301-334)."""
+    _, proposal = eth_setup
+    vote = build_vote(proposal, True, EthereumConsensusSigner.random(), NOW)
+    vote.signature = b"\x07" * 64
+    invalid = proposal.clone()
+    invalid.votes.append(vote)
+    with pytest.raises(ConsensusSchemeError):
+        validate_proposal(invalid, EthereumConsensusSigner, NOW)
+
+
+def test_vote_chain_rejects_parent_hash_owner_mismatch(eth_setup):
+    _, proposal = eth_setup
+    # Build both votes off the 1-vote proposal so each received_hash links to
+    # the owner's vote; then vote_two's parent points at vote_one (different
+    # owner) which must fail the parent-chain check.
+    base = proposal.clone()
+    vote_one = build_vote(base, True, EthereumConsensusSigner.random(), NOW)
+    base.votes.append(vote_one)
+    voter_two = EthereumConsensusSigner.random()
+    vote_two = build_vote(base, False, voter_two, NOW)
+    vote_two.parent_hash = bytes(vote_one.vote_hash)
+    resign_vote(vote_two, voter_two)
+
+    invalid = proposal.clone()
+    invalid.votes.extend([vote_one, vote_two])
+    with pytest.raises(ParentHashMismatch):
+        validate_proposal(invalid, EthereumConsensusSigner, NOW)
