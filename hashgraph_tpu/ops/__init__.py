@@ -1,0 +1,34 @@
+"""Device kernels: vectorized consensus math over dense proposal batches.
+
+Everything here is jit/vmap/shard_map-friendly JAX with static shapes and no
+data-dependent Python control flow. The scalar oracle these kernels must match
+bit-for-bit lives in :mod:`hashgraph_tpu.protocol`.
+"""
+
+from .decide import (
+    STATE_ACTIVE,
+    STATE_FAILED,
+    STATE_FREE,
+    STATE_REACHED_NO,
+    STATE_REACHED_YES,
+    decide_kernel,
+    decide_update,
+    required_votes_np,
+    state_result,
+    timeout_update,
+)
+from .ingest import ingest_kernel
+
+__all__ = [
+    "STATE_FREE",
+    "STATE_ACTIVE",
+    "STATE_FAILED",
+    "STATE_REACHED_NO",
+    "STATE_REACHED_YES",
+    "decide_kernel",
+    "decide_update",
+    "timeout_update",
+    "required_votes_np",
+    "state_result",
+    "ingest_kernel",
+]

@@ -1,0 +1,133 @@
+"""The consensus decision kernel, vectorized over a dense proposal batch.
+
+Reproduces ``calculate_consensus_result`` (reference: src/utils.rs:227-286)
+elementwise over ``[P]`` arrays of vote tallies. All inputs are int32/bool;
+the only floating-point step — converting a threshold to an integer required
+vote count — happens once per proposal on the host in IEEE-754 f64
+(:func:`required_votes_np`), exactly matching the reference's Rust f64 math,
+so the device kernel is pure integer arithmetic and bit-exact by construction.
+
+Design notes (TPU):
+- branch-free ``where`` ladders instead of control flow, so XLA fuses the
+  whole decision into one elementwise kernel over HBM-resident state;
+- int32 tallies (voter counts are bounded by the pool's voter capacity);
+  the u32-extreme cases stay on the scalar host path;
+- no cross-proposal communication: the kernel shards trivially over the
+  proposal axis of a device mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+# Proposal slot lifecycle states (dense int8 codes).
+STATE_FREE = 0  # unallocated pool slot
+STATE_ACTIVE = 1  # accepting votes
+STATE_FAILED = 2  # ConsensusState::Failed
+STATE_REACHED_NO = 3  # ConsensusReached(false)
+STATE_REACHED_YES = 4  # ConsensusReached(true)
+
+_F64_EPS = float(np.finfo(np.float64).eps)  # == Rust f64::EPSILON
+_TWO_THIRDS = 2.0 / 3.0
+_U32_MAX = 0xFFFFFFFF
+
+
+def required_votes_np(
+    expected_voters: np.ndarray, consensus_threshold: np.ndarray | float
+) -> np.ndarray:
+    """Host-side ``calculate_threshold_based_value`` over arrays
+    (reference: src/utils.rs:307-313).
+
+    The 2/3 default takes the exact-integer ``div_ceil(2n, 3)`` path; any
+    other threshold uses ``ceil(n * t)`` in f64 (numpy float64 == Rust f64),
+    with the final u32-saturating cast mirrored. Returns int64 (values are
+    bounded by n, so they fit whatever the device needs).
+    """
+    n = np.asarray(expected_voters, dtype=np.int64)
+    t = np.broadcast_to(np.asarray(consensus_threshold, dtype=np.float64), n.shape)
+    exact_path = np.abs(t - _TWO_THIRDS) < _F64_EPS
+    exact = (2 * n + 2) // 3
+    general = np.ceil(n.astype(np.float64) * t)
+    general = np.clip(general, 0, _U32_MAX).astype(np.int64)
+    return np.where(exact_path, exact, general)
+
+
+def decide_kernel(yes, tot, n, req, liveness, is_timeout):
+    """Elementwise decision over ``[P]`` tallies.
+
+    Args:
+      yes: int32[P] YES votes recorded.
+      tot: int32[P] total votes recorded.
+      n: int32[P] expected voters.
+      req: int32[P] precomputed ceil(n*threshold) (see required_votes_np).
+      liveness: bool[P] silent-peers-count-as-YES flag.
+      is_timeout: bool[P] (or scalar) timeout-path flag.
+
+    Returns:
+      (decided, result): bool[P] pair; ``result`` is meaningful only where
+      ``decided`` is True. Mirrors reference src/utils.rs:227-286 exactly:
+      n<=2 unanimity, quorum gate (silent peers join at timeout), silent-peer
+      weighting, strict-majority wins, full-participation tie-break.
+    """
+    no = tot - yes
+    silent = jnp.maximum(n - tot, 0)
+
+    # n <= 2 unanimity branch (utils.rs:239-244) — unaffected by is_timeout.
+    small = n <= 2
+    small_decided = tot >= n
+    small_result = yes == n
+
+    # Quorum gate (utils.rs:246-255): at timeout, silent peers count.
+    eff = jnp.where(is_timeout, n, tot)
+    gate = eff >= req
+
+    # Silent-peer weighting (utils.rs:258-271).
+    zeros = jnp.zeros_like(silent)
+    yes_w = yes + jnp.where(liveness, silent, zeros)
+    no_w = no + jnp.where(liveness, zeros, silent)
+
+    yes_win = (yes_w >= req) & (yes_w > no_w)
+    no_win = (no_w >= req) & (no_w > yes_w)
+    # Tie-break only at full participation (utils.rs:281-283).
+    tie = (tot == n) & (yes_w == no_w)
+
+    big_decided = gate & (yes_win | no_win | tie)
+    big_result = jnp.where(yes_win, True, jnp.where(no_win, False, liveness))
+
+    decided = jnp.where(small, small_decided, big_decided)
+    result = jnp.where(small, small_result, big_result)
+    return decided, result
+
+
+def decide_update(state, yes, tot, n, req, liveness):
+    """Post-ingest consensus check (is_timeout=False) applied to ACTIVE slots.
+
+    Mirrors ``ConsensusSession::check_consensus`` (reference:
+    src/session.rs:372-387): undecided slots stay ACTIVE.
+    """
+    decided, result = decide_kernel(yes, tot, n, req, liveness, False)
+    active = state == STATE_ACTIVE
+    reached = jnp.where(result, STATE_REACHED_YES, STATE_REACHED_NO).astype(state.dtype)
+    return jnp.where(active & decided, reached, state)
+
+
+def timeout_update(state, yes, tot, n, req, liveness, timeout_mask):
+    """Timeout decision for masked slots (is_timeout=True).
+
+    Mirrors ``handle_consensus_timeout`` (reference: src/service.rs:329-348):
+    already-decided slots are untouched (idempotent); undecidable ACTIVE
+    slots transition to FAILED.
+    """
+    decided, result = decide_kernel(yes, tot, n, req, liveness, True)
+    fires = (state == STATE_ACTIVE) & timeout_mask
+    reached = jnp.where(result, STATE_REACHED_YES, STATE_REACHED_NO).astype(state.dtype)
+    outcome = jnp.where(decided, reached, jnp.asarray(STATE_FAILED, state.dtype))
+    return jnp.where(fires, outcome, state)
+
+
+def state_result(state):
+    """Map slot states to (has_result, result) pairs for host readback."""
+    has_result = (state == STATE_REACHED_YES) | (state == STATE_REACHED_NO)
+    return has_result, state == STATE_REACHED_YES

@@ -1,0 +1,184 @@
+"""Batched vote ingest over the dense proposal pool.
+
+Applies a batch of (already host-validated) votes to the device-resident pool
+with semantics bit-identical to repeated ``ConsensusSession::add_vote``
+(reference: src/session.rs:225-249): per-proposal votes apply in arrival
+order with the exact precedence chain — already-reached (no-op success) →
+session-not-active → proposal-expired → round-cap (fails the session) →
+duplicate-owner → accept, then the consensus check runs on the updated tally.
+
+Layout: the host groups the batch by proposal slot into an ``[S, L]`` grid
+(S touched slots, L = max votes per slot in this batch, padded). The kernel
+gathers each touched slot's state, runs a ``lax.scan`` of length L — one vote
+per slot per step, vectorized across all S slots — and scatters results back.
+Wall-clock scales with the *deepest* per-proposal vote chain in the batch,
+not the batch size: breadth-heavy workloads (many proposals, few votes each)
+are nearly fully parallel; depth-heavy replays serialize only within a
+proposal, exactly like the protocol itself does.
+
+Padding contract: pad rows carry ``slot_id == P`` (out of range). Gathers
+clip (values unused), scatters drop — so pad rows can never corrupt slot 0.
+Pad cells within a real row have ``valid == False``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..errors import StatusCode
+from .decide import (
+    STATE_ACTIVE,
+    STATE_FAILED,
+    STATE_REACHED_NO,
+    STATE_REACHED_YES,
+    decide_kernel,
+)
+
+# Status emitted for padding cells (no vote present).
+PAD_STATUS = -1
+
+def group_batch(slot_idx: np.ndarray):
+    """Host-side: group a flat vote batch by proposal slot into grid
+    coordinates, preserving arrival order within each slot.
+
+    Returns ``(uniq_slots[S], row[B], col[B], L)`` where batch item ``b``
+    lands at grid cell ``(row[b], col[b])`` and ``L`` is the deepest
+    per-slot chain. Stable sort keeps the protocol's order-sensitivity
+    (round caps, mid-batch consensus cuts) intact.
+    """
+    b_count = len(slot_idx)
+    if b_count == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64), np.empty(0, np.int64), 0
+    order = np.argsort(slot_idx, kind="stable")
+    sorted_slots = slot_idx[order]
+    uniq, inverse_sorted, counts = np.unique(
+        sorted_slots, return_inverse=True, return_counts=True
+    )
+    starts = np.cumsum(counts) - counts
+    pos_sorted = np.arange(b_count) - starts[inverse_sorted]
+    row = np.empty(b_count, dtype=np.int64)
+    col = np.empty(b_count, dtype=np.int64)
+    row[order] = inverse_sorted
+    col[order] = pos_sorted
+    return uniq, row, col, int(counts.max())
+
+
+_OK = int(StatusCode.OK)
+_ALREADY_REACHED = int(StatusCode.ALREADY_REACHED)
+_SESSION_NOT_ACTIVE = int(StatusCode.SESSION_NOT_ACTIVE)
+_PROPOSAL_EXPIRED = int(StatusCode.PROPOSAL_EXPIRED)
+_MAX_ROUNDS_EXCEEDED = int(StatusCode.MAX_ROUNDS_EXCEEDED)
+_DUPLICATE_VOTE = int(StatusCode.DUPLICATE_VOTE)
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+def ingest_kernel(
+    state,  # int32[P] slot lifecycle
+    yes,  # int32[P] YES tally
+    tot,  # int32[P] total tally
+    vote_mask,  # bool[P, V] who has voted
+    vote_val,  # bool[P, V] their choice
+    n,  # int32[P] expected voters
+    req,  # int32[P] precomputed required votes
+    cap,  # int32[P] max round limit (max_round_limit semantics)
+    gossipsub,  # bool[P] gossipsub round semantics flag
+    liveness,  # bool[P] silent-peers-as-YES flag
+    slot_ids,  # int32[S] touched slots (P = pad sentinel)
+    expired,  # bool[S] host-computed `now >= expiration` per touched slot
+    voter_grid,  # int32[S, L] voter index within [0, V)
+    val_grid,  # bool[S, L] vote choice
+    valid_grid,  # bool[S, L] cell-is-a-real-vote mask
+):
+    """Returns (updated pool arrays..., statuses int32[S, L], final row state
+    int32[S])."""
+    s_count = slot_ids.shape[0]
+    rows = jnp.arange(s_count)
+
+    gather = lambda arr: jnp.take(arr, slot_ids, axis=0, mode="clip")
+    row_state = gather(state)
+    row_yes = gather(yes)
+    row_tot = gather(tot)
+    row_mask = gather(vote_mask)
+    row_val = gather(vote_val)
+    row_n = gather(n)
+    row_req = gather(req)
+    row_cap = gather(cap)
+    row_gossip = gather(gossipsub)
+    row_live = gather(liveness)
+
+    def step(carry, xs):
+        st, ys, tt, mask, vals = carry
+        voter, val, valid = xs
+
+        reached = (st == STATE_REACHED_YES) | (st == STATE_REACHED_NO)
+        active = st == STATE_ACTIVE
+        # Round projection (reference: src/session.rs:306-344): gossipsub
+        # always projects round 2 when adding a vote; P2P projects
+        # accepted-votes + 1 (round == tot + 1 invariant).
+        projected = jnp.where(row_gossip, 2, tt + 1)
+        exceeded = projected > row_cap
+        dup = mask[rows, voter]
+
+        ok = valid & active & ~expired & ~exceeded & ~dup
+        status = jnp.where(
+            ~valid,
+            PAD_STATUS,
+            jnp.where(
+                reached,
+                _ALREADY_REACHED,
+                jnp.where(
+                    ~active,
+                    _SESSION_NOT_ACTIVE,
+                    jnp.where(
+                        expired,
+                        _PROPOSAL_EXPIRED,
+                        jnp.where(
+                            exceeded,
+                            _MAX_ROUNDS_EXCEEDED,
+                            jnp.where(dup, _DUPLICATE_VOTE, _OK),
+                        ),
+                    ),
+                ),
+            ),
+        ).astype(jnp.int32)
+
+        # A cap violation moves the session to Failed even though the vote is
+        # rejected (reference: src/session.rs:334-341).
+        st = jnp.where(valid & active & ~expired & exceeded, STATE_FAILED, st)
+
+        tt = tt + ok.astype(tt.dtype)
+        ys = ys + (ok & val).astype(ys.dtype)
+        mask = mask.at[rows, voter].set(dup | ok)
+        vals = vals.at[rows, voter].set(jnp.where(ok, val, vals[rows, voter]))
+
+        # Consensus check on the updated tally (is_timeout=False).
+        decided, result = decide_kernel(ys, tt, row_n, row_req, row_live, False)
+        newly = ok & decided
+        reached_state = jnp.where(result, STATE_REACHED_YES, STATE_REACHED_NO)
+        st = jnp.where(newly, reached_state.astype(st.dtype), st)
+
+        return (st, ys, tt, mask, vals), status
+
+    carry0 = (row_state, row_yes, row_tot, row_mask, row_val)
+    # Scan over vote positions: xs steps through columns of the [S, L] grids.
+    (row_state, row_yes, row_tot, row_mask, row_val), statuses = lax.scan(
+        step,
+        carry0,
+        (voter_grid.T, val_grid.T, valid_grid.T),
+    )
+    statuses = statuses.T  # [L, S] -> [S, L]
+
+    scatter = lambda arr, rows_val: arr.at[slot_ids].set(rows_val, mode="drop")
+    state = scatter(state, row_state)
+    yes = scatter(yes, row_yes)
+    tot = scatter(tot, row_tot)
+    vote_mask = scatter(vote_mask, row_mask)
+    vote_val = scatter(vote_val, row_val)
+
+    return state, yes, tot, vote_mask, vote_val, statuses, row_state

@@ -1,14 +1,23 @@
 """Test harness config: force JAX onto a virtual 8-device CPU mesh.
 
 Multi-chip hardware is not available in CI; sharding tests run against
-XLA's host-platform device partitioning instead. Must run before jax import.
+XLA's host-platform device partitioning instead.
+
+Note: the environment's sitecustomize imports jax at interpreter startup
+and pins JAX_PLATFORMS=axon (the TPU tunnel), so env vars alone are too
+late — we must go through jax.config before the backend initializes.
+XLA_FLAGS is still read lazily at first backend init, so setting it here
+works as long as no jax computation ran yet.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

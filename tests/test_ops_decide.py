@@ -1,0 +1,148 @@
+"""Bit-exactness parity: vectorized decide kernel vs the scalar oracle.
+
+Exhaustive sweep over small (n, tot, yes, liveness, is_timeout) space for a
+spread of thresholds — every golden case from the reference's threshold tables
+is contained in this grid — plus randomized large-n spot checks.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hashgraph_tpu.ops import (
+    STATE_ACTIVE,
+    STATE_FAILED,
+    STATE_REACHED_NO,
+    STATE_REACHED_YES,
+    decide_kernel,
+    decide_update,
+    required_votes_np,
+    timeout_update,
+)
+from hashgraph_tpu.protocol import (
+    calculate_threshold_based_value,
+    decide as scalar_decide,
+)
+
+THRESHOLDS = [2.0 / 3.0, 0.5, 0.6, 0.9, 1.0, 0.0, 0.61, 0.667]
+
+
+def build_cases(threshold, n_max=12, tot_extra=2):
+    """All (yes, tot, n, liveness, timeout) combos; tot may exceed n (more
+    distinct voters than expected is representable in the reference)."""
+    rows = []
+    for n in range(1, n_max + 1):
+        for tot in range(0, n + tot_extra + 1):
+            for yes in range(0, tot + 1):
+                for liveness in (False, True):
+                    for is_timeout in (False, True):
+                        rows.append((yes, tot, n, liveness, is_timeout))
+    return np.array(rows, dtype=np.int64)
+
+
+@pytest.mark.parametrize("threshold", THRESHOLDS)
+def test_decide_kernel_matches_scalar_oracle(threshold):
+    cases = build_cases(threshold)
+    yes, tot, n = cases[:, 0], cases[:, 1], cases[:, 2]
+    liveness, is_timeout = cases[:, 3].astype(bool), cases[:, 4].astype(bool)
+    req = required_votes_np(n, threshold)
+
+    decided, result = jax.jit(decide_kernel)(
+        jnp.asarray(yes, jnp.int32),
+        jnp.asarray(tot, jnp.int32),
+        jnp.asarray(n, jnp.int32),
+        jnp.asarray(req, jnp.int32),
+        jnp.asarray(liveness),
+        jnp.asarray(is_timeout),
+    )
+    decided = np.asarray(decided)
+    result = np.asarray(result)
+
+    for i in range(len(cases)):
+        expected = scalar_decide(
+            int(yes[i]), int(tot[i]), int(n[i]), threshold, bool(liveness[i]), bool(is_timeout[i])
+        )
+        got = bool(result[i]) if decided[i] else None
+        assert got == expected, (
+            f"mismatch at yes={yes[i]} tot={tot[i]} n={n[i]} t={threshold} "
+            f"live={liveness[i]} timeout={is_timeout[i]}: kernel={got} oracle={expected}"
+        )
+
+
+def test_required_votes_matches_scalar_for_large_n():
+    rng = np.random.default_rng(42)
+    n = rng.integers(1, 2**30, size=2000)
+    for threshold in THRESHOLDS:
+        req = required_votes_np(n, threshold)
+        for i in range(0, 2000, 97):
+            assert req[i] == calculate_threshold_based_value(int(n[i]), threshold)
+
+
+def test_large_n_randomized_parity():
+    rng = np.random.default_rng(7)
+    size = 5000
+    n = rng.integers(3, 2**20, size=size)
+    tot = (n * rng.random(size)).astype(np.int64)
+    yes = (tot * rng.random(size)).astype(np.int64)
+    liveness = rng.random(size) < 0.5
+    is_timeout = rng.random(size) < 0.5
+    threshold = 2.0 / 3.0
+    req = required_votes_np(n, threshold)
+
+    decided, result = jax.jit(decide_kernel)(
+        jnp.asarray(yes, jnp.int32),
+        jnp.asarray(tot, jnp.int32),
+        jnp.asarray(n, jnp.int32),
+        jnp.asarray(req, jnp.int32),
+        jnp.asarray(liveness),
+        jnp.asarray(is_timeout),
+    )
+    decided, result = np.asarray(decided), np.asarray(result)
+    for i in range(0, size, 131):
+        expected = scalar_decide(
+            int(yes[i]), int(tot[i]), int(n[i]), threshold, bool(liveness[i]), bool(is_timeout[i])
+        )
+        got = bool(result[i]) if decided[i] else None
+        assert got == expected
+
+
+class TestStateUpdates:
+    def test_decide_update_transitions_only_active(self):
+        # slots: active-reaching, active-undecided, already failed, reached-no
+        state = jnp.asarray([STATE_ACTIVE, STATE_ACTIVE, STATE_FAILED, STATE_REACHED_NO], jnp.int32)
+        yes = jnp.asarray([3, 1, 3, 0], jnp.int32)
+        tot = jnp.asarray([3, 1, 3, 3], jnp.int32)
+        n = jnp.asarray([4, 4, 4, 4], jnp.int32)
+        req = jnp.asarray(required_votes_np(np.array([4, 4, 4, 4]), 2 / 3), jnp.int32)
+        liveness = jnp.asarray([True, True, True, True])
+
+        new_state = decide_update(state, yes, tot, n, req, liveness)
+        assert list(np.asarray(new_state)) == [
+            STATE_REACHED_YES,  # 3 yes + 1 silent-as-yes -> 4 >= 3
+            STATE_ACTIVE,  # 1 vote < quorum 3
+            STATE_FAILED,  # untouched
+            STATE_REACHED_NO,  # untouched
+        ]
+
+    def test_timeout_update_masks_and_fails(self):
+        state = jnp.asarray([STATE_ACTIVE, STATE_ACTIVE, STATE_ACTIVE, STATE_REACHED_YES], jnp.int32)
+        yes = jnp.asarray([1, 1, 2, 0], jnp.int32)
+        tot = jnp.asarray([2, 3, 2, 0], jnp.int32)
+        n = jnp.asarray([4, 4, 4, 4], jnp.int32)
+        req = jnp.asarray(required_votes_np(np.array([4, 4, 4, 4]), 2 / 3), jnp.int32)
+        liveness = jnp.asarray([True, True, False, True])
+        # slot1: 1 yes 2 no 1 silent-as-yes -> 2-2 weighted tie, tot<n -> Failed
+        # slot0: 1 yes 1 no 2 silent-as-yes -> 3 yes >= 3, 3 > 1 -> ReachedYes
+        # slot2: masked out -> unchanged
+        # slot3: already reached -> idempotent
+        mask = jnp.asarray([True, True, False, True])
+
+        new_state = timeout_update(state, yes, tot, n, req, liveness, mask)
+        assert list(np.asarray(new_state)) == [
+            STATE_REACHED_YES,
+            STATE_FAILED,
+            STATE_ACTIVE,
+            STATE_REACHED_YES,
+        ]

@@ -1,0 +1,272 @@
+"""Parity fuzz: batched ingest kernel vs sequential scalar ``add_vote``.
+
+Random traces over a pool of proposals with mixed modes/thresholds/expiry,
+including duplicate voters, round-cap overruns, mid-batch consensus cuts, and
+votes to decided/failed sessions. The device statuses, tallies, masks, and
+final states must match the scalar session engine exactly, vote by vote.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hashgraph_tpu import ConsensusConfig, CreateProposalRequest
+from hashgraph_tpu.errors import (
+    ConsensusError,
+    StatusCode,
+)
+from hashgraph_tpu.ops import (
+    STATE_ACTIVE,
+    STATE_FAILED,
+    STATE_REACHED_NO,
+    STATE_REACHED_YES,
+    required_votes_np,
+)
+from hashgraph_tpu.ops.ingest import PAD_STATUS, group_batch, ingest_kernel
+from hashgraph_tpu.session import ConsensusSession
+from hashgraph_tpu.wire import Vote
+
+NOW = 1_700_000_000
+V_CAP = 16  # voter capacity per proposal in these tests
+
+
+def scalar_state_code(session: ConsensusSession) -> int:
+    if session.state.is_active:
+        return STATE_ACTIVE
+    if session.state.is_failed:
+        return STATE_FAILED
+    return STATE_REACHED_YES if session.state.result else STATE_REACHED_NO
+
+
+def apply_scalar(session: ConsensusSession, voter: int, val: bool, now: int) -> int:
+    """Run one add_vote on the oracle; return the equivalent status code."""
+    before = len(session.votes)
+    vote = Vote(vote_owner=bytes([voter + 1]), vote=val, proposal_id=session.proposal.proposal_id)
+    try:
+        session.add_vote(vote, now)
+    except ConsensusError as exc:
+        return int(exc.code)
+    if len(session.votes) == before:
+        return int(StatusCode.ALREADY_REACHED)
+    return int(StatusCode.OK)
+
+
+def make_pool(configs):
+    """Build device pool arrays + scalar oracle sessions from per-slot specs:
+    (n, mode, liveness, threshold, expiration_offset)."""
+    p_count = len(configs)
+    state = np.full(p_count, STATE_ACTIVE, np.int32)
+    yes = np.zeros(p_count, np.int32)
+    tot = np.zeros(p_count, np.int32)
+    vote_mask = np.zeros((p_count, V_CAP), bool)
+    vote_val = np.zeros((p_count, V_CAP), bool)
+    n_arr = np.zeros(p_count, np.int32)
+    req = np.zeros(p_count, np.int32)
+    cap = np.zeros(p_count, np.int32)
+    gossip = np.zeros(p_count, bool)
+    liveness = np.zeros(p_count, bool)
+    expiry = np.zeros(p_count, np.int64)
+    sessions = []
+
+    for i, (n, mode, live, threshold, exp_off) in enumerate(configs):
+        config = (
+            ConsensusConfig.gossipsub() if mode == "gossipsub" else ConsensusConfig.p2p()
+        ).with_threshold(threshold)
+        request = CreateProposalRequest(
+            name=f"p{i}",
+            payload=b"",
+            proposal_owner=b"owner",
+            expected_voters_count=n,
+            expiration_timestamp=exp_off,
+            liveness_criteria_yes=live,
+        )
+        proposal = request.into_proposal(NOW)
+        proposal.proposal_id = i + 1
+        sessions.append(ConsensusSession._new(proposal, config, NOW))
+        n_arr[i] = n
+        req[i] = required_votes_np(np.array([n]), threshold)[0]
+        cap[i] = config.max_round_limit(n)
+        gossip[i] = config.use_gossipsub_rounds
+        liveness[i] = live
+        expiry[i] = NOW + exp_off
+
+    return (
+        dict(
+            state=state,
+            yes=yes,
+            tot=tot,
+            vote_mask=vote_mask,
+            vote_val=vote_val,
+            n=n_arr,
+            req=req,
+            cap=cap,
+            gossip=gossip,
+            liveness=liveness,
+            expiry=expiry,
+        ),
+        sessions,
+    )
+
+
+def run_ingest(pool, slots, voters, vals, now):
+    """Group the flat batch, run the kernel, return per-vote statuses in
+    batch order plus updated numpy pool arrays."""
+    slots = np.asarray(slots, np.int64)
+    uniq, row, col, depth = group_batch(slots)
+    s_count = len(uniq)
+    voter_grid = np.zeros((s_count, depth), np.int32)
+    val_grid = np.zeros((s_count, depth), bool)
+    valid_grid = np.zeros((s_count, depth), bool)
+    voter_grid[row, col] = voters
+    val_grid[row, col] = vals
+    valid_grid[row, col] = True
+    expired = (expiry_of(pool, uniq) <= now)
+
+    out = ingest_kernel(
+        jnp.asarray(pool["state"]),
+        jnp.asarray(pool["yes"]),
+        jnp.asarray(pool["tot"]),
+        jnp.asarray(pool["vote_mask"]),
+        jnp.asarray(pool["vote_val"]),
+        jnp.asarray(pool["n"]),
+        jnp.asarray(pool["req"]),
+        jnp.asarray(pool["cap"]),
+        jnp.asarray(pool["gossip"]),
+        jnp.asarray(pool["liveness"]),
+        jnp.asarray(uniq, jnp.int32),
+        jnp.asarray(expired),
+        jnp.asarray(voter_grid),
+        jnp.asarray(val_grid),
+        jnp.asarray(valid_grid),
+    )
+    state, yes, tot, vote_mask, vote_val, statuses, row_state = map(np.asarray, out)
+    pool.update(state=state, yes=yes, tot=tot, vote_mask=vote_mask, vote_val=vote_val)
+    return statuses[row, col]
+
+
+def expiry_of(pool, uniq):
+    return pool["expiry"][uniq]
+
+
+class TestIngestParity:
+    def _compare(self, pool, sessions, trace, now=NOW):
+        slots = np.array([t[0] for t in trace])
+        voters = np.array([t[1] for t in trace], np.int32)
+        vals = np.array([t[2] for t in trace], bool)
+
+        device_statuses = run_ingest(pool, slots, voters, vals, now)
+        for b, (slot, voter, val) in enumerate(trace):
+            expected = apply_scalar(sessions[slot], int(voter), bool(val), now)
+            assert device_statuses[b] == expected, (
+                f"vote {b} (slot={slot} voter={voter} val={val}): "
+                f"device={StatusCode(device_statuses[b]).name} "
+                f"oracle={StatusCode(expected).name}"
+            )
+
+        # Final states + tallies must agree.
+        for i, session in enumerate(sessions):
+            assert pool["state"][i] == scalar_state_code(session), f"slot {i} state"
+            assert pool["tot"][i] == len(session.votes), f"slot {i} total"
+            yes_scalar = sum(1 for v in session.votes.values() if v.vote)
+            assert pool["yes"][i] == yes_scalar, f"slot {i} yes"
+            for voter_idx in range(V_CAP):
+                owner = bytes([voter_idx + 1])
+                assert pool["vote_mask"][i, voter_idx] == (owner in session.votes)
+                if owner in session.votes:
+                    assert pool["vote_val"][i, voter_idx] == session.votes[owner].vote
+
+    def test_basic_consensus_cut_midbatch(self):
+        # n=3 gossipsub: third YES is a no-op (consensus after 2nd).
+        pool, sessions = make_pool([(3, "gossipsub", True, 2 / 3, 1000)])
+        self._compare(
+            pool, sessions, [(0, 0, True), (0, 1, True), (0, 2, True)]
+        )
+        assert pool["state"][0] == STATE_REACHED_YES
+        assert pool["tot"][0] == 2  # third vote was not inserted
+
+    def test_duplicate_voters(self):
+        pool, sessions = make_pool([(5, "gossipsub", True, 2 / 3, 1000)])
+        self._compare(
+            pool,
+            sessions,
+            [(0, 0, True), (0, 0, False), (0, 1, False), (0, 1, False)],
+        )
+
+    def test_p2p_round_cap_fails_session_midbatch(self):
+        # n=4 p2p: cap=3; 4th vote exceeds -> Failed; 5th gets SessionNotActive.
+        pool, sessions = make_pool([(4, "p2p", False, 2 / 3, 1000)])
+        self._compare(
+            pool,
+            sessions,
+            [(0, 0, True), (0, 1, False), (0, 2, True), (0, 3, True), (0, 4, True)],
+        )
+        assert pool["state"][0] == STATE_FAILED
+
+    def test_expired_slot(self):
+        pool, sessions = make_pool([(3, "gossipsub", True, 2 / 3, 10)])
+        slots = np.array([0])
+        voters = np.array([0], np.int32)
+        vals = np.array([True])
+        statuses = run_ingest(pool, slots, voters, vals, NOW + 10)
+        assert statuses[0] == int(StatusCode.PROPOSAL_EXPIRED)
+        expected = apply_scalar(sessions[0], 0, True, NOW + 10)
+        assert statuses[0] == expected
+
+    def test_cap_violation_beats_duplicate(self):
+        # Precedence: round-cap check fires before the duplicate check
+        # (reference: src/session.rs:232-239).
+        pool, sessions = make_pool([(4, "p2p", False, 2 / 3, 1000)])
+        self._compare(
+            pool,
+            sessions,
+            [(0, 0, True), (0, 1, False), (0, 2, True), (0, 0, True)],
+        )
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_randomized_trace_parity(self, seed):
+        rng = np.random.default_rng(seed)
+        configs = []
+        for _ in range(12):
+            n = int(rng.integers(1, 13))
+            mode = "gossipsub" if rng.random() < 0.5 else "p2p"
+            live = bool(rng.random() < 0.5)
+            threshold = float(rng.choice([2 / 3, 0.5, 0.9, 1.0]))
+            exp_off = int(rng.choice([5, 1000]))  # some expire under test `now`
+            configs.append((n, mode, live, threshold, exp_off))
+        pool, sessions = make_pool(configs)
+
+        trace = []
+        for _ in range(150):
+            slot = int(rng.integers(0, len(configs)))
+            voter = int(rng.integers(0, V_CAP))
+            val = bool(rng.random() < 0.5)
+            trace.append((slot, voter, val))
+
+        self._compare(pool, sessions, trace, now=NOW + 6)
+
+    def test_pad_rows_cannot_corrupt_pool(self):
+        pool, sessions = make_pool([(3, "gossipsub", True, 2 / 3, 1000)])
+        p_count = len(sessions)
+        # One real row + one pad row with slot_id == P (sentinel).
+        out = ingest_kernel(
+            jnp.asarray(pool["state"]),
+            jnp.asarray(pool["yes"]),
+            jnp.asarray(pool["tot"]),
+            jnp.asarray(pool["vote_mask"]),
+            jnp.asarray(pool["vote_val"]),
+            jnp.asarray(pool["n"]),
+            jnp.asarray(pool["req"]),
+            jnp.asarray(pool["cap"]),
+            jnp.asarray(pool["gossip"]),
+            jnp.asarray(pool["liveness"]),
+            jnp.asarray([0, p_count], jnp.int32),
+            jnp.asarray([False, False]),
+            jnp.asarray([[0], [0]], jnp.int32),
+            jnp.asarray([[True], [True]]),
+            jnp.asarray([[True], [False]]),  # pad row: all cells invalid
+        )
+        state, yes, tot, mask, vals, statuses, _ = map(np.asarray, out)
+        assert statuses[0, 0] == int(StatusCode.OK)
+        assert statuses[1, 0] == PAD_STATUS
+        assert tot[0] == 1 and yes[0] == 1
