@@ -1,0 +1,18 @@
+"""The TPU execution engine: device-resident pool + batch-first service.
+
+This package is where the framework stops mirroring the reference's shape
+and becomes a TPU program: consensus state lives in dense ``[P]``/``[P, V]``
+HBM arrays (:mod:`.pool`), mutations are batched kernel dispatches, and the
+reference's scalar API is a thin veneer over the batch path (:mod:`.engine`).
+"""
+
+from .engine import SessionRecord, TpuConsensusEngine
+from .pool import PoolFullError, ProposalPool, SlotMeta
+
+__all__ = [
+    "TpuConsensusEngine",
+    "SessionRecord",
+    "ProposalPool",
+    "SlotMeta",
+    "PoolFullError",
+]

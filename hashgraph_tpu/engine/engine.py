@@ -1,0 +1,625 @@
+"""TpuConsensusEngine: the batch-first consensus service backed by the pool.
+
+This is the framework's flagship execution path (SURVEY §7, BASELINE north
+star): the same observable semantics as :class:`~hashgraph_tpu.service.
+ConsensusService` — scalar entry points included — but with all tally/round/
+decision state dense on device and a native batch API (:meth:`ingest_votes`)
+the scalar calls funnel into. Host work per vote is limited to what XLA
+cannot do: signature/hash validation (pluggable scheme, CPU), owner→lane
+dictionary lookups, and event emission.
+
+Division of labor:
+- device (ProposalPool): tallies, vote masks, round-cap projection, the
+  decision kernel, timeout sweeps — everything order-sensitive is replayed
+  arrival-ordered by the scan inside the ingest kernel;
+- host (this class): vote build/validation (reference: src/utils.rs:55-171),
+  scope configs and their resolution precedence (src/service.rs:440-484),
+  per-scope session registries with LRU eviction (src/service.rs:512-522),
+  proposal reconstruction for gossip, and the event bus.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Generic, Hashable, TypeVar
+
+import numpy as np
+
+from ..errors import (
+    ConsensusError,
+    InsufficientVotesAtTimeout,
+    ProposalAlreadyExist,
+    SessionNotFound,
+    StatusCode,
+    UserAlreadyVoted,
+    error_for_code,
+)
+from ..events import BroadcastEventBus, ConsensusEventBus
+from ..ops.decide import (
+    STATE_ACTIVE,
+    STATE_FAILED,
+    STATE_REACHED_NO,
+    STATE_REACHED_YES,
+    required_votes_np,
+)
+from ..protocol import build_vote, validate_proposal_timestamp, validate_vote
+from ..scope_config import ScopeConfig, ScopeConfigBuilder
+from ..service import DEFAULT_MAX_SESSIONS_PER_SCOPE, ConsensusStats
+from ..session import ConsensusConfig, ConsensusSession, ConsensusState
+from ..signing import ConsensusSignatureScheme
+from ..types import (
+    ConsensusEvent,
+    ConsensusFailedEvent,
+    ConsensusReached,
+    CreateProposalRequest,
+)
+from ..wire import Proposal, Vote
+from .pool import ProposalPool
+
+Scope = TypeVar("Scope", bound=Hashable)
+
+_U32_MAX = 0xFFFFFFFF
+
+_STATE_TO_SCALAR = {
+    STATE_ACTIVE: ConsensusState.active(),
+    STATE_FAILED: ConsensusState.failed(),
+    STATE_REACHED_YES: ConsensusState.reached(True),
+    STATE_REACHED_NO: ConsensusState.reached(False),
+}
+
+
+@dataclass
+class SessionRecord(Generic[Scope]):
+    """Host-side view of one pooled session (scalar bookkeeping the device
+    doesn't need; vote bytes kept for gossip reconstruction and chain
+    linking, reference: src/utils.rs:62-77)."""
+
+    scope: Scope
+    slot: int
+    proposal: Proposal  # votes list appended in acceptance order
+    config: ConsensusConfig
+    created_at: int
+    votes: dict[bytes, Vote] = field(default_factory=dict)  # accepted only
+
+    def bump_round(self, accepted: int) -> None:
+        """Host mirror of the device round update
+        (reference: src/session.rs:351-366)."""
+        if accepted <= 0:
+            return
+        if self.config.use_gossipsub_rounds:
+            if self.proposal.round == 1:
+                self.proposal.round = 2
+        else:
+            self.proposal.round = min(self.proposal.round + accepted, _U32_MAX)
+
+
+class TpuConsensusEngine(Generic[Scope]):
+    """Batch consensus engine with the ConsensusService API surface.
+
+    Capacity is fixed at construction (XLA static shapes): ``capacity``
+    concurrent sessions across all scopes, ``voter_capacity`` voter lanes per
+    proposal. Scalar and batch entry points share one code path: every
+    mutation flows through :meth:`ingest_votes`.
+    """
+
+    def __init__(
+        self,
+        signer: ConsensusSignatureScheme,
+        event_bus: ConsensusEventBus[Scope] | None = None,
+        capacity: int = 4096,
+        voter_capacity: int = 64,
+        max_sessions_per_scope: int = DEFAULT_MAX_SESSIONS_PER_SCOPE,
+    ):
+        self._signer = signer
+        self._event_bus: ConsensusEventBus[Scope] = (
+            event_bus if event_bus is not None else BroadcastEventBus()
+        )
+        self._pool = ProposalPool(capacity, voter_capacity)
+        self._max_sessions_per_scope = max_sessions_per_scope
+
+        self._records: dict[int, SessionRecord[Scope]] = {}  # slot -> record
+        self._index: dict[tuple[Scope, int], int] = {}  # (scope, pid) -> slot
+        self._scopes: dict[Scope, list[int]] = {}  # scope -> slots (insertion order)
+        self._scope_configs: dict[Scope, ScopeConfig] = {}
+
+    # ── Accessors ──────────────────────────────────────────────────────
+
+    def signer(self) -> ConsensusSignatureScheme:
+        return self._signer
+
+    def event_bus(self) -> ConsensusEventBus[Scope]:
+        return self._event_bus
+
+    def pool(self) -> ProposalPool:
+        return self._pool
+
+    @property
+    def _scheme(self) -> type[ConsensusSignatureScheme]:
+        return type(self._signer)
+
+    # ── Proposal lifecycle ─────────────────────────────────────────────
+
+    def create_proposal(
+        self,
+        scope: Scope,
+        request: CreateProposalRequest,
+        now: int,
+        config: ConsensusConfig | None = None,
+    ) -> Proposal:
+        """Create a local proposal and claim a pool slot
+        (reference: src/service.rs:183-209)."""
+        proposal = request.into_proposal(now)
+        resolved = self._resolve_config(scope, config, proposal)
+        self._register(scope, proposal, resolved, now, state_code=STATE_ACTIVE)
+        return proposal.clone()
+
+    def process_incoming_proposal(
+        self, scope: Scope, proposal: Proposal, now: int
+    ) -> None:
+        """Validate a network proposal (signatures, chain, expiry — the full
+        scalar gauntlet, reference: src/session.rs:198-221) and load the
+        replayed session into the pool as a dense row (resume-from-snapshot).
+        """
+        if (scope, proposal.proposal_id) in self._index:
+            raise ProposalAlreadyExist()
+        config = self._resolve_config(scope, None, proposal)
+        # The scalar oracle replays embedded votes with exact reference
+        # semantics (chain validation, per-vote ECDSA, round caps); the dense
+        # row is loaded from its final state.
+        session, transition = ConsensusSession.from_proposal(
+            proposal.clone(), self._scheme, config, now
+        )
+        # Event before save, as in the reference (src/service.rs:275-277).
+        if transition.is_reached:
+            self._emit(
+                scope,
+                ConsensusReached(
+                    proposal_id=proposal.proposal_id,
+                    result=transition.reached,
+                    timestamp=now,
+                ),
+            )
+        self._register_session(scope, session, now)
+
+    def _register(
+        self,
+        scope: Scope,
+        proposal: Proposal,
+        config: ConsensusConfig,
+        now: int,
+        state_code: int,
+    ) -> SessionRecord[Scope]:
+        n = proposal.expected_voters_count
+        threshold = config.consensus_threshold
+        slot = self._pool.allocate_batch(
+            keys=[(scope, proposal.proposal_id)],
+            n=np.array([n]),
+            req=required_votes_np(np.array([n]), threshold),
+            cap=np.array([config.max_round_limit(n)]),
+            gossip=np.array([config.use_gossipsub_rounds]),
+            liveness=np.array([proposal.liveness_criteria_yes]),
+            expiry=np.array([proposal.expiration_timestamp]),
+            created_at=np.array([now]),
+        )[0]
+        record = SessionRecord(
+            scope=scope,
+            slot=slot,
+            proposal=proposal,
+            config=config,
+            created_at=now,
+        )
+        self._records[slot] = record
+        self._index[(scope, proposal.proposal_id)] = slot
+        self._scopes.setdefault(scope, []).append(slot)
+        if state_code != STATE_ACTIVE:
+            raise AssertionError("fresh registrations start ACTIVE")
+        self._trim_scope(scope)
+        return record
+
+    def _register_session(
+        self, scope: Scope, session: ConsensusSession, now: int
+    ) -> None:
+        """Load a replayed scalar session (possibly already decided) into a
+        fresh slot."""
+        proposal = session.proposal
+        record = self._register(scope, proposal, session.config, now, STATE_ACTIVE)
+        if record.slot not in self._records:
+            return  # evicted immediately by the per-scope cap (created_at tie)
+        record.votes = dict(session.votes)
+        if session.votes:
+            meta = self._pool.meta(record.slot)
+            vcap = self._pool.voter_capacity
+            mask = np.zeros((1, vcap), bool)
+            vals = np.zeros((1, vcap), bool)
+            for owner, vote in session.votes.items():
+                lane = meta.lane_for(owner, vcap)
+                if lane is None:  # > V distinct voters in embedded chain
+                    raise ConsensusError(
+                        "embedded vote chain exceeds pool voter capacity"
+                    )
+                mask[0, lane] = True
+                vals[0, lane] = vote.vote
+            state = {
+                True: STATE_REACHED_YES,
+                False: STATE_REACHED_NO,
+            }[session.state.result] if session.state.is_reached else (
+                STATE_FAILED if session.state.is_failed else STATE_ACTIVE
+            )
+            yes = sum(1 for v in session.votes.values() if v.vote)
+            self._pool.load_rows(
+                [record.slot],
+                state=np.array([state]),
+                yes=np.array([yes]),
+                tot=np.array([len(session.votes)]),
+                mask_rows=mask,
+                val_rows=vals,
+            )
+
+    # ── Voting ─────────────────────────────────────────────────────────
+
+    def cast_vote(self, scope: Scope, proposal_id: int, choice: bool, now: int) -> Vote:
+        """Sign, chain, and apply this peer's vote
+        (reference: src/service.rs:216-237)."""
+        record = self._get_record(scope, proposal_id)
+        validate_proposal_timestamp(record.proposal.expiration_timestamp, now)
+        if self._signer.identity() in record.votes:
+            raise UserAlreadyVoted()
+        vote = build_vote(record.proposal, choice, self._signer, now)
+        statuses = self.ingest_votes(
+            [(scope, vote)], now, pre_validated=True
+        )
+        exc = error_for_code(int(statuses[0]))
+        if exc is not None:
+            raise exc()
+        return vote
+
+    def cast_vote_and_get_proposal(
+        self, scope: Scope, proposal_id: int, choice: bool, now: int
+    ) -> Proposal:
+        """reference: src/service.rs:243-253"""
+        self.cast_vote(scope, proposal_id, choice, now)
+        return self._get_record(scope, proposal_id).proposal.clone()
+
+    def process_incoming_vote(self, scope: Scope, vote: Vote, now: int) -> None:
+        """Scalar network-vote entry point (reference: src/service.rs:286-305):
+        full host validation, then the batched device path."""
+        statuses = self.ingest_votes([(scope, vote)], now)
+        exc = error_for_code(int(statuses[0]))
+        if exc is not None:
+            raise exc()
+
+    def ingest_votes(
+        self,
+        items: list[tuple[Scope, Vote]],
+        now: int,
+        pre_validated: bool = False,
+    ) -> np.ndarray:
+        """THE batch hot path: apply many votes across many sessions/scopes
+        in one device dispatch.
+
+        Per vote: resolve the session, host-validate (hash, signature,
+        replay/expiry — skipped when ``pre_validated``, for locally built or
+        already-verified replay traces), map owner→lane, then run the
+        arrival-ordered ingest kernel. Emits ConsensusReached events for every
+        session the batch decides. Returns int32 status codes in batch order
+        (StatusCode.OK / ALREADY_REACHED are successes).
+        """
+        batch = len(items)
+        statuses = np.zeros(batch, np.int32)
+        dev_rows: list[int] = []  # indices into items that reach the device
+        slots = np.empty(batch, np.int64)
+        lanes = np.empty(batch, np.int32)
+        values = np.empty(batch, bool)
+
+        for i, (scope, vote) in enumerate(items):
+            slot = self._index.get((scope, vote.proposal_id))
+            if slot is None:
+                statuses[i] = int(StatusCode.SESSION_NOT_FOUND)
+                continue
+            record = self._records[slot]
+            if not pre_validated:
+                try:
+                    validate_vote(
+                        vote,
+                        self._scheme,
+                        record.proposal.expiration_timestamp,
+                        record.proposal.timestamp,
+                        now,
+                    )
+                except ConsensusError as exc:
+                    statuses[i] = int(exc.code)
+                    continue
+            lane = self._pool.meta(slot).lane_for(
+                vote.vote_owner, self._pool.voter_capacity
+            )
+            if lane is None:
+                statuses[i] = int(StatusCode.VOTER_CAPACITY_EXCEEDED)
+                continue
+            slots[len(dev_rows)] = slot
+            lanes[len(dev_rows)] = lane
+            values[len(dev_rows)] = vote.vote
+            dev_rows.append(i)
+
+        if not dev_rows:
+            return statuses
+
+        k = len(dev_rows)
+        dev_statuses, transitions = self._pool.ingest(
+            slots[:k], lanes[:k], values[:k], now
+        )
+        statuses[np.asarray(dev_rows)] = dev_statuses
+
+        # Host bookkeeping for accepted votes, in arrival order; remember the
+        # last accepted vote per slot — that is the vote that flipped a slot
+        # that ended the batch decided (OK can never follow REACHED).
+        last_ok: dict[int, int] = {}
+        for j, i in enumerate(dev_rows):
+            if dev_statuses[j] == int(StatusCode.OK):
+                scope, vote = items[i]
+                record = self._records[int(slots[j])]
+                record.votes[vote.vote_owner] = vote
+                record.proposal.votes.append(vote)
+                record.bump_round(1)
+                last_ok[int(slots[j])] = j
+
+        # Event emission in per-vote arrival order, mirroring the scalar
+        # path exactly: the deciding vote emits ConsensusReached, and every
+        # later vote to the decided session re-emits it (the reference's
+        # add_vote returns the existing result, which process_incoming_vote
+        # turns into another event — src/session.rs:246, src/service.rs:303).
+        # A STATE_FAILED transition (round-cap overrun) emits nothing,
+        # matching the MaxRoundsExceeded error path (src/session.rs:334-343).
+        newly_reached = {
+            slot: new_state
+            for slot, new_state in transitions
+            if new_state in (STATE_REACHED_YES, STATE_REACHED_NO)
+        }
+        for j, i in enumerate(dev_rows):
+            slot = int(slots[j])
+            code = int(dev_statuses[j])
+            emit_reached = (
+                code == int(StatusCode.OK)
+                and slot in newly_reached
+                and last_ok.get(slot) == j
+            ) or code == int(StatusCode.ALREADY_REACHED)
+            if emit_reached:
+                record = self._records[slot]
+                state = self._pool.state_of(slot)
+                self._emit(
+                    record.scope,
+                    ConsensusReached(
+                        proposal_id=record.proposal.proposal_id,
+                        result=state == STATE_REACHED_YES,
+                        timestamp=now,
+                    ),
+                )
+        return statuses
+
+    # ── Timeouts ───────────────────────────────────────────────────────
+
+    def handle_consensus_timeout(self, scope: Scope, proposal_id: int, now: int) -> bool:
+        """App-driven timeout for one session
+        (reference: src/service.rs:323-373). Idempotent for decided sessions;
+        raises InsufficientVotesAtTimeout (after emitting ConsensusFailed)
+        when undecidable."""
+        slot = self._index.get((scope, proposal_id))
+        if slot is None:
+            raise SessionNotFound()
+        [(_, new_state)] = self._pool.timeout([slot])
+        if new_state in (STATE_REACHED_YES, STATE_REACHED_NO):
+            result = new_state == STATE_REACHED_YES
+            self._emit(
+                scope,
+                ConsensusReached(
+                    proposal_id=proposal_id, result=result, timestamp=now
+                ),
+            )
+            return result
+        self._emit(scope, ConsensusFailedEvent(proposal_id=proposal_id, timestamp=now))
+        raise InsufficientVotesAtTimeout()
+
+    def sweep_timeouts(self, now: int) -> list[tuple[Scope, int, bool | None]]:
+        """Engine-level convenience absent from the reference (its embedder
+        schedules per-proposal timers): fire the timeout decision for every
+        still-undecided session whose expiration has passed, in one device
+        dispatch. Returns (scope, proposal_id, result-or-None) per swept
+        session and emits the same events as per-session timeouts."""
+        expired: list[int] = []
+        for slot, record in self._records.items():
+            if self._pool.state_of(slot) in (STATE_ACTIVE, STATE_FAILED):
+                if self._pool.meta(slot).expiry <= now:
+                    expired.append(slot)
+        out: list[tuple[Scope, int, bool | None]] = []
+        for slot, new_state in self._pool.timeout(expired):
+            record = self._records[slot]
+            pid = record.proposal.proposal_id
+            if new_state in (STATE_REACHED_YES, STATE_REACHED_NO):
+                result = new_state == STATE_REACHED_YES
+                self._emit(
+                    record.scope,
+                    ConsensusReached(proposal_id=pid, result=result, timestamp=now),
+                )
+                out.append((record.scope, pid, result))
+            else:
+                self._emit(
+                    record.scope,
+                    ConsensusFailedEvent(proposal_id=pid, timestamp=now),
+                )
+                out.append((record.scope, pid, None))
+        return out
+
+    # ── Queries (reference: src/storage.rs:112-180 derived helpers) ────
+
+    def get_proposal(self, scope: Scope, proposal_id: int) -> Proposal:
+        return self._get_record(scope, proposal_id).proposal.clone()
+
+    def get_consensus_result(self, scope: Scope, proposal_id: int) -> bool | None:
+        """None while active; ConsensusFailed is surfaced as None too (the
+        reference storage helper returns Err(ConsensusFailed) — scalar
+        wrappers that need the error can check session state)."""
+        record = self._get_record(scope, proposal_id)
+        state = self._pool.state_of(record.slot)
+        if state == STATE_REACHED_YES:
+            return True
+        if state == STATE_REACHED_NO:
+            return False
+        return None
+
+    def get_active_proposals(self, scope: Scope) -> list[Proposal]:
+        return [
+            r.proposal.clone()
+            for r in self._scope_records(scope)
+            if self._pool.state_of(r.slot) == STATE_ACTIVE
+        ]
+
+    def get_reached_proposals(self, scope: Scope) -> list[tuple[Proposal, bool]]:
+        out = []
+        for r in self._scope_records(scope):
+            state = self._pool.state_of(r.slot)
+            if state in (STATE_REACHED_YES, STATE_REACHED_NO):
+                out.append((r.proposal.clone(), state == STATE_REACHED_YES))
+        return out
+
+    def get_scope_stats(self, scope: Scope) -> ConsensusStats:
+        """reference: src/service_stats.rs:32-59 (zeros for unknown scope)."""
+        stats = ConsensusStats()
+        for r in self._scope_records(scope):
+            stats.total_sessions += 1
+            state = self._pool.state_of(r.slot)
+            if state == STATE_ACTIVE:
+                stats.active_sessions += 1
+            elif state == STATE_FAILED:
+                stats.failed_sessions += 1
+            else:
+                stats.consensus_reached += 1
+        return stats
+
+    def export_session(self, scope: Scope, proposal_id: int) -> ConsensusSession:
+        """Materialise a scalar ConsensusSession from the pooled state —
+        the bridge back to ConsensusStorage backends (checkpoint/interop)."""
+        record = self._get_record(scope, proposal_id)
+        return ConsensusSession(
+            proposal=record.proposal.clone(),
+            state=_STATE_TO_SCALAR[self._pool.state_of(record.slot)],
+            votes={k: v.clone() for k, v in record.votes.items()},
+            created_at=record.created_at,
+            config=record.config,
+        )
+
+    def delete_scope(self, scope: Scope) -> None:
+        """Drop every session and the config of a scope
+        (reference: src/storage.rs:92 delete_scope semantics)."""
+        slots = self._scopes.pop(scope, [])
+        for slot in slots:
+            record = self._records.pop(slot)
+            del self._index[(scope, record.proposal.proposal_id)]
+        self._pool.release(slots)
+        self._scope_configs.pop(scope, None)
+
+    # ── Scope config (reference: src/service.rs:375-484) ───────────────
+
+    def scope(self, scope: Scope):
+        """Fluent per-scope configuration builder, same surface as the
+        scalar service (reference: src/service.rs:558-668)."""
+        from ..service import ScopeConfigBuilderWrapper
+
+        existing = self._scope_configs.get(scope)
+        builder = (
+            ScopeConfigBuilder.from_existing(existing)
+            if existing is not None
+            else ScopeConfigBuilder()
+        )
+        return ScopeConfigBuilderWrapper(self, scope, builder)
+
+    def set_scope_config(self, scope: Scope, config: ScopeConfig) -> None:
+        config.validate()
+        self._scope_configs[scope] = config
+
+    def get_scope_config(self, scope: Scope) -> ScopeConfig | None:
+        return self._scope_configs.get(scope)
+
+    # ScopeConfigBuilderWrapper terminal hooks (shared with the service).
+    def _initialize_scope(self, scope: Scope, config: ScopeConfig) -> None:
+        self.set_scope_config(scope, config)
+
+    def _update_scope_config(self, scope: Scope, config: ScopeConfig) -> None:
+        """Create-default-then-mutate-then-validate, matching
+        InMemoryConsensusStorage.update_scope_config
+        (reference: src/storage.rs:366-375)."""
+        existing = self._scope_configs.get(scope, ScopeConfig())
+        existing.network_type = config.network_type
+        existing.default_consensus_threshold = config.default_consensus_threshold
+        existing.default_timeout = config.default_timeout
+        existing.default_liveness_criteria_yes = config.default_liveness_criteria_yes
+        existing.max_rounds_override = config.max_rounds_override
+        existing.validate()
+        self._scope_configs[scope] = existing
+
+    def _resolve_config(
+        self,
+        scope: Scope,
+        proposal_override: ConsensusConfig | None,
+        proposal: Proposal | None,
+    ) -> ConsensusConfig:
+        """Same precedence as the service: explicit override > scope config >
+        gossipsub default; timeout from the proposal's expiration window
+        unless overridden; liveness always from the proposal
+        (reference: src/service.rs:440-484)."""
+        has_override = proposal_override is not None
+        if proposal_override is not None:
+            base = proposal_override
+        else:
+            scope_config = self._scope_configs.get(scope)
+            base = (
+                ConsensusConfig.from_scope_config(scope_config)
+                if scope_config is not None
+                else ConsensusConfig.gossipsub()
+            )
+        if proposal is None:
+            return base
+        if has_override:
+            timeout_seconds = base.consensus_timeout
+        elif proposal.expiration_timestamp > proposal.timestamp:
+            timeout_seconds = float(proposal.expiration_timestamp - proposal.timestamp)
+        else:
+            timeout_seconds = base.consensus_timeout
+        return ConsensusConfig(
+            consensus_threshold=base.consensus_threshold,
+            consensus_timeout=timeout_seconds,
+            max_rounds=base.max_rounds,
+            use_gossipsub_rounds=base.use_gossipsub_rounds,
+            liveness_criteria=proposal.liveness_criteria_yes,
+        )
+
+    # ── Internals ──────────────────────────────────────────────────────
+
+    def _get_record(self, scope: Scope, proposal_id: int) -> SessionRecord[Scope]:
+        slot = self._index.get((scope, proposal_id))
+        if slot is None:
+            raise SessionNotFound()
+        return self._records[slot]
+
+    def _scope_records(self, scope: Scope) -> list[SessionRecord[Scope]]:
+        return [self._records[s] for s in self._scopes.get(scope, [])]
+
+    def _trim_scope(self, scope: Scope) -> None:
+        """LRU-by-created_at eviction beyond the per-scope cap
+        (reference: src/service.rs:512-522): keep the newest max sessions."""
+        slots = self._scopes.get(scope, [])
+        if len(slots) <= self._max_sessions_per_scope:
+            return
+        ranked = sorted(
+            slots,
+            key=lambda s: self._records[s].created_at,
+            reverse=True,
+        )
+        keep = set(ranked[: self._max_sessions_per_scope])
+        evicted = [s for s in slots if s not in keep]
+        self._scopes[scope] = [s for s in slots if s in keep]
+        for slot in evicted:
+            record = self._records.pop(slot)
+            del self._index[(scope, record.proposal.proposal_id)]
+        self._pool.release(evicted)
+
+    def _emit(self, scope: Scope, event: ConsensusEvent) -> None:
+        self._event_bus.publish(scope, event)

@@ -1,0 +1,443 @@
+"""Device-resident proposal pool: dense slot-indexed consensus state.
+
+The pool is the TPU analogue of the reference's per-scope session maps
+(reference: src/storage.rs:188-194): a fixed-capacity, structure-of-arrays
+store of ``P`` proposal slots × ``V`` voter lanes living in device HBM.
+Scalar per-session control flow becomes batched scatter/scan/gather kernels
+(:mod:`hashgraph_tpu.ops`); the host keeps only the irregular bookkeeping XLA
+cannot express with static shapes — the free list, slot↔proposal mapping,
+owner-bytes→voter-lane dictionaries, and expiry timestamps.
+
+Design notes (TPU):
+- fixed capacity: slot allocation/eviction churn never changes array shapes,
+  so every kernel compiles once per pool geometry;
+- buffer donation on every mutation: the pool state is updated in place in
+  HBM, no copy-on-write traffic;
+- readbacks are narrow: ingest returns per-vote statuses and touched-slot
+  states only; full-row gathers (:meth:`ProposalPool.read_slot`) are a cold
+  query path;
+- the host mirrors the ``state`` vector (updated from kernel readbacks, never
+  re-fetched) so stats and transition detection cost no device traffic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Hashable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.decide import (
+    STATE_ACTIVE,
+    STATE_FAILED,
+    STATE_FREE,
+    STATE_REACHED_NO,
+    STATE_REACHED_YES,
+    timeout_kernel,
+)
+from ..ops.ingest import group_batch, ingest_kernel
+
+__all__ = ["ProposalPool", "SlotMeta", "PoolFullError"]
+
+
+class PoolFullError(RuntimeError):
+    """The pool has no free slots (capacity P exhausted)."""
+
+
+@dataclass
+class SlotMeta:
+    """Host-side bookkeeping for one allocated slot."""
+
+    key: Hashable  # engine-level key, e.g. (scope, proposal_id)
+    expiry: int  # absolute expiration timestamp (seconds)
+    created_at: int
+    voter_lanes: dict[bytes, int] = field(default_factory=dict)  # owner -> lane
+
+    def lane_for(self, owner: bytes, capacity: int) -> int | None:
+        """Owner-bytes → voter-lane dictionary (SURVEY §7: duplicate-owner
+        detection needs exact bytes, not a hash that could collide). Returns
+        None when all V lanes are taken by *other* owners — the protocol
+        bounds distinct voters by expected_voters_count ≤ V, so this only
+        happens for votes that would be rejected anyway."""
+        lane = self.voter_lanes.get(owner)
+        if lane is None:
+            if len(self.voter_lanes) >= capacity:
+                return None
+            lane = len(self.voter_lanes)
+            self.voter_lanes[owner] = lane
+        return lane
+
+
+@partial(jax.jit, donate_argnums=tuple(range(10)))
+def _activate_kernel(
+    state,
+    yes,
+    tot,
+    vote_mask,
+    vote_val,
+    n,
+    req,
+    cap,
+    gossip,
+    liveness,
+    slot_ids,
+    n_new,
+    req_new,
+    cap_new,
+    gossip_new,
+    live_new,
+):
+    """Claim slots for new proposals: reset tallies, write per-slot config."""
+    put = lambda arr, val: arr.at[slot_ids].set(val, mode="drop")
+    state = put(state, STATE_ACTIVE)
+    yes = put(yes, 0)
+    tot = put(tot, 0)
+    vote_mask = put(vote_mask, False)
+    vote_val = put(vote_val, False)
+    n = put(n, n_new)
+    req = put(req, req_new)
+    cap = put(cap, cap_new)
+    gossip = put(gossip, gossip_new)
+    liveness = put(liveness, live_new)
+    return state, yes, tot, vote_mask, vote_val, n, req, cap, gossip, liveness
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+def _load_kernel(
+    state,
+    yes,
+    tot,
+    vote_mask,
+    vote_val,
+    slot_ids,
+    state_rows,
+    yes_rows,
+    tot_rows,
+    mask_rows,
+    val_rows,
+):
+    """Snapshot-restore tallies into already-activated slots (resume path:
+    a network proposal arrives carrying validated votes, reference:
+    src/session.rs:198-221 replays them — here the host replays through the
+    scalar oracle and loads the resulting dense rows)."""
+    put = lambda arr, rows: arr.at[slot_ids].set(rows, mode="drop")
+    return (
+        put(state, state_rows),
+        put(yes, yes_rows),
+        put(tot, tot_rows),
+        put(vote_mask, mask_rows),
+        put(vote_val, val_rows),
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _release_kernel(state, slot_ids):
+    return state.at[slot_ids].set(STATE_FREE, mode="drop")
+
+
+@jax.jit
+def _read_kernel(state, yes, tot, vote_mask, vote_val, slot_id):
+    take = lambda arr: jnp.take(arr, slot_id, axis=0, mode="clip")
+    return take(state), take(yes), take(tot), take(vote_mask), take(vote_val)
+
+
+class ProposalPool:
+    """Fixed-capacity device pool of consensus proposal slots.
+
+    ``capacity`` (P) bounds concurrent proposals; ``voter_capacity`` (V)
+    bounds ``expected_voters_count`` per proposal. All mutating methods are
+    batched; statuses and transitions are returned per call with no global
+    readbacks.
+    """
+
+    def __init__(self, capacity: int, voter_capacity: int):
+        if capacity < 1 or voter_capacity < 1:
+            raise ValueError("capacity and voter_capacity must be >= 1")
+        self.capacity = capacity
+        self.voter_capacity = voter_capacity
+
+        self._state = jnp.full(capacity, STATE_FREE, jnp.int32)
+        self._yes = jnp.zeros(capacity, jnp.int32)
+        self._tot = jnp.zeros(capacity, jnp.int32)
+        self._vote_mask = jnp.zeros((capacity, voter_capacity), bool)
+        self._vote_val = jnp.zeros((capacity, voter_capacity), bool)
+        self._n = jnp.zeros(capacity, jnp.int32)
+        self._req = jnp.zeros(capacity, jnp.int32)
+        self._cap = jnp.zeros(capacity, jnp.int32)
+        self._gossip = jnp.zeros(capacity, bool)
+        self._liveness = jnp.zeros(capacity, bool)
+
+        # Host mirrors / bookkeeping.
+        self._state_host = np.full(capacity, STATE_FREE, np.int32)
+        self._free: list[int] = list(range(capacity - 1, -1, -1))
+        self._meta: dict[int, SlotMeta] = {}
+
+    # ── Introspection ──────────────────────────────────────────────────
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocated_slots(self) -> int:
+        return self.capacity - len(self._free)
+
+    def meta(self, slot: int) -> SlotMeta:
+        return self._meta[slot]
+
+    def state_of(self, slot: int) -> int:
+        """Host-mirrored lifecycle state (no device traffic)."""
+        return int(self._state_host[slot])
+
+    def state_counts(self) -> dict[int, int]:
+        """Histogram of slot states from the host mirror (stats path,
+        reference: src/service_stats.rs:32-59)."""
+        values, counts = np.unique(self._state_host, return_counts=True)
+        return {int(v): int(c) for v, c in zip(values, counts)}
+
+    # ── Allocation ─────────────────────────────────────────────────────
+
+    def allocate_batch(
+        self,
+        keys: list[Hashable],
+        n: np.ndarray,
+        req: np.ndarray,
+        cap: np.ndarray,
+        gossip: np.ndarray,
+        liveness: np.ndarray,
+        expiry: np.ndarray,
+        created_at: np.ndarray,
+    ) -> list[int]:
+        """Claim one slot per key and initialise its on-device config.
+
+        ``req``/``cap`` are host-precomputed (exact integer threshold math,
+        reference: src/utils.rs:307-313 — see ops.decide.required_votes_np).
+        Raises PoolFullError (allocating nothing) if fewer than len(keys)
+        slots are free.
+        """
+        count = len(keys)
+        if count == 0:
+            return []
+        n = np.asarray(n, np.int32)
+        if int(n.max()) > self.voter_capacity:
+            raise ValueError(
+                f"expected_voters_count {int(n.max())} exceeds pool "
+                f"voter_capacity {self.voter_capacity}"
+            )
+        if count > len(self._free):
+            raise PoolFullError(
+                f"need {count} slots, {len(self._free)} free of {self.capacity}"
+            )
+        slots = [self._free.pop() for _ in range(count)]
+        slot_ids = jnp.asarray(np.asarray(slots, np.int32))
+
+        (
+            self._state,
+            self._yes,
+            self._tot,
+            self._vote_mask,
+            self._vote_val,
+            self._n,
+            self._req,
+            self._cap,
+            self._gossip,
+            self._liveness,
+        ) = _activate_kernel(
+            self._state,
+            self._yes,
+            self._tot,
+            self._vote_mask,
+            self._vote_val,
+            self._n,
+            self._req,
+            self._cap,
+            self._gossip,
+            self._liveness,
+            slot_ids,
+            jnp.asarray(n),
+            jnp.asarray(np.asarray(req, np.int32)),
+            jnp.asarray(np.asarray(cap, np.int32)),
+            jnp.asarray(np.asarray(gossip, bool)),
+            jnp.asarray(np.asarray(liveness, bool)),
+        )
+
+        expiry = np.asarray(expiry, np.int64)
+        created_at = np.asarray(created_at, np.int64)
+        for i, slot in enumerate(slots):
+            self._state_host[slot] = STATE_ACTIVE
+            self._meta[slot] = SlotMeta(
+                key=keys[i], expiry=int(expiry[i]), created_at=int(created_at[i])
+            )
+        return slots
+
+    def load_rows(
+        self,
+        slots: list[int],
+        state: np.ndarray,
+        yes: np.ndarray,
+        tot: np.ndarray,
+        mask_rows: np.ndarray,
+        val_rows: np.ndarray,
+    ) -> None:
+        """Overwrite tallies of already-allocated slots (snapshot restore)."""
+        if not slots:
+            return
+        slot_ids = jnp.asarray(np.asarray(slots, np.int32))
+        (
+            self._state,
+            self._yes,
+            self._tot,
+            self._vote_mask,
+            self._vote_val,
+        ) = _load_kernel(
+            self._state,
+            self._yes,
+            self._tot,
+            self._vote_mask,
+            self._vote_val,
+            slot_ids,
+            jnp.asarray(np.asarray(state, np.int32)),
+            jnp.asarray(np.asarray(yes, np.int32)),
+            jnp.asarray(np.asarray(tot, np.int32)),
+            jnp.asarray(np.asarray(mask_rows, bool)),
+            jnp.asarray(np.asarray(val_rows, bool)),
+        )
+        self._state_host[np.asarray(slots)] = np.asarray(state, np.int32)
+
+    def release(self, slots: list[int]) -> None:
+        """Return slots to the free list (eviction / delete_scope). Tallies
+        are lazily cleared on the next allocation of the slot."""
+        if not slots:
+            return
+        self._state = _release_kernel(
+            self._state, jnp.asarray(np.asarray(slots, np.int32))
+        )
+        for slot in slots:
+            self._state_host[slot] = STATE_FREE
+            del self._meta[slot]
+            self._free.append(slot)
+
+    # ── Hot paths ──────────────────────────────────────────────────────
+
+    def ingest(
+        self,
+        slots: np.ndarray,
+        lanes: np.ndarray,
+        values: np.ndarray,
+        now: int,
+    ) -> tuple[np.ndarray, list[tuple[int, int]]]:
+        """Apply a flat, arrival-ordered vote batch.
+
+        Args:
+          slots: int64[B] target slot per vote.
+          lanes: int32[B] voter lane per vote (from SlotMeta.lane_for).
+          values: bool[B] the yes/no choices.
+          now: caller clock, for the per-slot expiry check
+            (reference: src/session.rs:226).
+
+        Returns:
+          (statuses int32[B] in batch order, transitions) where transitions
+          lists (slot, new_state) for every slot whose lifecycle state
+          changed — the engine turns these into ConsensusReached events.
+        """
+        slots = np.asarray(slots, np.int64)
+        if slots.size == 0:
+            return np.empty(0, np.int32), []
+        uniq, row, col, depth = group_batch(slots)
+        s_count = len(uniq)
+        voter_grid = np.zeros((s_count, depth), np.int32)
+        val_grid = np.zeros((s_count, depth), bool)
+        valid_grid = np.zeros((s_count, depth), bool)
+        voter_grid[row, col] = np.asarray(lanes, np.int32)
+        val_grid[row, col] = np.asarray(values, bool)
+        valid_grid[row, col] = True
+
+        expiry = np.array(
+            [self._meta[s].expiry if s in self._meta else 0 for s in uniq],
+            np.int64,
+        )
+        expired = expiry <= now
+
+        (
+            self._state,
+            self._yes,
+            self._tot,
+            self._vote_mask,
+            self._vote_val,
+            statuses,
+            row_state,
+        ) = ingest_kernel(
+            self._state,
+            self._yes,
+            self._tot,
+            self._vote_mask,
+            self._vote_val,
+            self._n,
+            self._req,
+            self._cap,
+            self._gossip,
+            self._liveness,
+            jnp.asarray(uniq.astype(np.int32)),
+            jnp.asarray(expired),
+            jnp.asarray(voter_grid),
+            jnp.asarray(val_grid),
+            jnp.asarray(valid_grid),
+        )
+        statuses = np.asarray(statuses)
+        row_state = np.asarray(row_state)
+
+        transitions: list[tuple[int, int]] = []
+        for i, slot in enumerate(uniq):
+            new_state = int(row_state[i])
+            if self._state_host[slot] != new_state:
+                self._state_host[slot] = new_state
+                transitions.append((int(slot), new_state))
+        return statuses[row, col], transitions
+
+    def timeout(self, slots: list[int]) -> list[tuple[int, int]]:
+        """Fire the timeout decision for the given slots.
+
+        Returns (slot, new_state) for each *requested* slot after the sweep
+        (including unchanged already-decided ones, so the caller can
+        implement the reference's idempotent timeout return,
+        src/service.rs:331-334).
+        """
+        if not slots:
+            return []
+        slot_ids = jnp.asarray(np.asarray(slots, np.int32))
+        self._state, row_state = timeout_kernel(
+            self._state,
+            self._yes,
+            self._tot,
+            self._n,
+            self._req,
+            self._liveness,
+            slot_ids,
+        )
+        row_state = np.asarray(row_state)
+        out: list[tuple[int, int]] = []
+        for i, slot in enumerate(slots):
+            new_state = int(row_state[i])
+            self._state_host[slot] = new_state
+            out.append((int(slot), new_state))
+        return out
+
+    # ── Cold query path ────────────────────────────────────────────────
+
+    def read_slot(self, slot: int) -> dict[str, np.ndarray]:
+        """Gather one slot's full row back to host (debug / session export)."""
+        state, yes, tot, mask, vals = _read_kernel(
+            self._state, self._yes, self._tot, self._vote_mask, self._vote_val,
+            jnp.asarray(slot, jnp.int32),
+        )
+        return dict(
+            state=np.asarray(state),
+            yes=np.asarray(yes),
+            tot=np.asarray(tot),
+            vote_mask=np.asarray(mask),
+            vote_val=np.asarray(vals),
+        )

@@ -63,6 +63,12 @@ class StatusCode(enum.IntEnum):
     # inserting the vote (reference: src/session.rs:246). Not an error.
     ALREADY_REACHED = 28
 
+    # Batch-engine specific (no reference analogue): the proposal's device
+    # voter lanes are exhausted — more than voter_capacity distinct owners
+    # voted on one proposal. Only possible in Gossipsub mode, which accepts
+    # any number of distinct voters; size voter_capacity accordingly.
+    VOTER_CAPACITY_EXCEEDED = 29
+
 
 class ConsensusError(Exception):
     """Base class for everything that can go wrong during consensus operations.
@@ -220,6 +226,13 @@ class ConsensusFailed(ConsensusError):
     default_message = "Consensus failed"
 
 
+class VoterCapacityExceeded(ConsensusError):
+    """Engine-specific: device voter lanes exhausted for this proposal."""
+
+    code = StatusCode.VOTER_CAPACITY_EXCEEDED
+    default_message = "Pool voter capacity exceeded for proposal"
+
+
 # ── Signature scheme errors (reference: src/signing.rs:77-86) ────────────
 
 
@@ -267,6 +280,7 @@ _CODE_TO_ERROR: dict[int, type[ConsensusError]] = {
         MaxRoundsExceeded,
         ConsensusNotReached,
         ConsensusFailed,
+        VoterCapacityExceeded,
         ConsensusSchemeError,
     ]
 }

@@ -18,8 +18,11 @@ Design notes (TPU):
 
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 # Proposal slot lifecycle states (dense int8 codes).
@@ -117,11 +120,13 @@ def timeout_update(state, yes, tot, n, req, liveness, timeout_mask):
     """Timeout decision for masked slots (is_timeout=True).
 
     Mirrors ``handle_consensus_timeout`` (reference: src/service.rs:329-348):
-    already-decided slots are untouched (idempotent); undecidable ACTIVE
-    slots transition to FAILED.
+    REACHED slots are untouched (idempotent); ACTIVE *and* FAILED slots are
+    recomputed — the reference mutator only short-circuits on ConsensusReached,
+    so a Failed session whose timeout fires again gets a fresh decision —
+    and transition to FAILED when undecidable.
     """
     decided, result = decide_kernel(yes, tot, n, req, liveness, True)
-    fires = (state == STATE_ACTIVE) & timeout_mask
+    fires = ((state == STATE_ACTIVE) | (state == STATE_FAILED)) & timeout_mask
     reached = jnp.where(result, STATE_REACHED_YES, STATE_REACHED_NO).astype(state.dtype)
     outcome = jnp.where(decided, reached, jnp.asarray(STATE_FAILED, state.dtype))
     return jnp.where(fires, outcome, state)
@@ -131,3 +136,19 @@ def state_result(state):
     """Map slot states to (has_result, result) pairs for host readback."""
     has_result = (state == STATE_REACHED_YES) | (state == STATE_REACHED_NO)
     return has_result, state == STATE_REACHED_YES
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def timeout_kernel(state, yes, tot, n, req, liveness, slot_ids):
+    """Fire the timeout decision for the given slots and return their new
+    states.
+
+    ``slot_ids`` uses the same pad contract as the ingest kernel: ids ``== P``
+    are out-of-range sentinels whose scatter drops and whose gather clips (the
+    clipped row's returned state is unused by the host). Mirrors
+    ``handle_consensus_timeout`` (reference: src/service.rs:329-348): REACHED
+    slots are untouched; ACTIVE/FAILED slots get a fresh timeout decision.
+    """
+    fires = jnp.zeros(state.shape, bool).at[slot_ids].set(True, mode="drop")
+    new_state = timeout_update(state, yes, tot, n, req, liveness, fires)
+    return new_state, jnp.take(new_state, slot_ids, mode="clip")

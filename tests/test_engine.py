@@ -1,0 +1,451 @@
+"""TpuConsensusEngine end-to-end: service-parity, batch ingest, timeouts.
+
+The engine must be observably identical to the scalar ConsensusService — the
+same API calls with the same inputs produce the same results, errors, events,
+and stored state (the bit-exactness bar from SURVEY §6). The strongest test
+here drives randomized mixed traces through both side by side.
+"""
+
+import numpy as np
+import pytest
+
+from hashgraph_tpu import (
+    BroadcastEventBus,
+    ConsensusConfig,
+    ConsensusError,
+    ConsensusFailedEvent,
+    ConsensusReached,
+    CreateProposalRequest,
+    InsufficientVotesAtTimeout,
+    NetworkType,
+    ProposalAlreadyExist,
+    ProposalExpired,
+    SessionNotFound,
+    StatusCode,
+    UserAlreadyVoted,
+    build_vote,
+)
+from hashgraph_tpu.engine import PoolFullError, ProposalPool, TpuConsensusEngine
+from hashgraph_tpu.errors import VoterCapacityExceeded
+
+from common import NOW, make_service, random_stub_signer
+
+
+def make_engine(**kw) -> TpuConsensusEngine:
+    kw.setdefault("capacity", 64)
+    kw.setdefault("voter_capacity", 16)
+    return TpuConsensusEngine(random_stub_signer(), **kw)
+
+
+def request(n=3, name="prop", exp=1000, liveness=True) -> CreateProposalRequest:
+    return CreateProposalRequest(
+        name=name,
+        payload=b"payload",
+        proposal_owner=b"owner",
+        expected_voters_count=n,
+        expiration_timestamp=exp,
+        liveness_criteria_yes=liveness,
+    )
+
+
+def drain(receiver):
+    events = []
+    while (item := receiver.try_recv()) is not None:
+        events.append(item)
+    return events
+
+
+class TestEngineBasicFlow:
+    def test_quickstart_three_voters(self):
+        """README quick-start: 3 voters, gossipsub, 2/3 — two YES decide."""
+        engine = make_engine()
+        receiver = engine.event_bus().subscribe()
+        proposal = engine.create_proposal("s", request(3), NOW)
+        pid = proposal.proposal_id
+
+        engine.cast_vote("s", pid, True, NOW)
+        assert engine.get_consensus_result("s", pid) is None
+
+        remote = random_stub_signer()
+        vote = build_vote(engine.get_proposal("s", pid), True, remote, NOW)
+        engine.process_incoming_vote("s", vote, NOW)
+
+        assert engine.get_consensus_result("s", pid) is True
+        events = drain(receiver)
+        assert events == [("s", ConsensusReached(pid, True, NOW))]
+
+    def test_cast_vote_twice_rejected(self):
+        engine = make_engine()
+        pid = engine.create_proposal("s", request(3), NOW).proposal_id
+        engine.cast_vote("s", pid, True, NOW)
+        with pytest.raises(UserAlreadyVoted):
+            engine.cast_vote("s", pid, False, NOW)
+
+    def test_unknown_session(self):
+        engine = make_engine()
+        with pytest.raises(SessionNotFound):
+            engine.cast_vote("s", 42, True, NOW)
+        with pytest.raises(SessionNotFound):
+            engine.handle_consensus_timeout("s", 42, NOW)
+
+    def test_expired_proposal_rejects_cast(self):
+        engine = make_engine()
+        pid = engine.create_proposal("s", request(3, exp=10), NOW).proposal_id
+        with pytest.raises(ProposalExpired):
+            engine.cast_vote("s", pid, True, NOW + 10)
+
+    def test_duplicate_incoming_proposal(self):
+        engine = make_engine()
+        proposal = engine.create_proposal("s", request(3), NOW)
+        with pytest.raises(ProposalAlreadyExist):
+            engine.process_incoming_proposal("s", proposal, NOW)
+
+    def test_scope_isolation(self):
+        engine = make_engine()
+        pid_a = engine.create_proposal("a", request(3), NOW).proposal_id
+        pid_b = engine.create_proposal("b", request(3), NOW).proposal_id
+        engine.cast_vote("a", pid_a, True, NOW)
+        with pytest.raises(SessionNotFound):
+            engine.get_proposal("b", pid_a) if pid_a != pid_b else (_ for _ in ()).throw(
+                SessionNotFound()
+            )
+        assert engine.get_scope_stats("a").total_sessions == 1
+        assert engine.get_scope_stats("b").total_sessions == 1
+
+
+class TestEngineIncomingProposal:
+    def test_embedded_votes_replayed(self):
+        """A proposal gossiped with its vote chain loads at the right tally."""
+        origin = make_engine()
+        proposal = origin.create_proposal("s", request(3), NOW)
+        pid = proposal.proposal_id
+        origin.cast_vote("s", pid, True, NOW)
+        carried = origin.get_proposal("s", pid)
+
+        receiver_engine = make_engine()
+        receiver_engine.process_incoming_proposal("s", carried, NOW)
+        # One more YES decides (2/3 of 3 = 2).
+        receiver_engine.cast_vote("s", pid, True, NOW)
+        assert receiver_engine.get_consensus_result("s", pid) is True
+
+    def test_already_decided_chain_emits_event_on_load(self):
+        origin = make_engine()
+        pid = origin.create_proposal("s", request(3), NOW).proposal_id
+        origin.cast_vote("s", pid, True, NOW)
+        v = build_vote(origin.get_proposal("s", pid), True, random_stub_signer(), NOW)
+        origin.process_incoming_vote("s", v, NOW)
+        carried = origin.get_proposal("s", pid)
+        assert origin.get_consensus_result("s", pid) is True
+
+        engine = make_engine()
+        receiver = engine.event_bus().subscribe()
+        engine.process_incoming_proposal("s", carried, NOW)
+        assert engine.get_consensus_result("s", pid) is True
+        assert drain(receiver) == [("s", ConsensusReached(pid, True, NOW))]
+
+
+class TestEngineTimeouts:
+    def _p2p_engine(self):
+        engine = make_engine()
+        engine.scope("s").with_network_type(NetworkType.P2P).initialize()
+        return engine
+
+    def test_timeout_reaches_with_liveness_yes(self):
+        """2 of 5 voted YES; liveness fills 3 silent as YES at timeout."""
+        engine = make_engine()
+        receiver = engine.event_bus().subscribe()
+        pid = engine.create_proposal("s", request(5, liveness=True), NOW).proposal_id
+        engine.cast_vote("s", pid, True, NOW)
+        v = build_vote(engine.get_proposal("s", pid), True, random_stub_signer(), NOW)
+        engine.process_incoming_vote("s", v, NOW)
+
+        result = engine.handle_consensus_timeout("s", pid, NOW + 100)
+        assert result is True
+        assert ("s", ConsensusReached(pid, True, NOW + 100)) in drain(receiver)
+
+    def test_timeout_no_result(self):
+        """liveness=False: 1 YES + 4 silent-as-NO -> NO at timeout."""
+        engine = make_engine()
+        pid = engine.create_proposal("s", request(5, liveness=False), NOW).proposal_id
+        engine.cast_vote("s", pid, True, NOW)
+        assert engine.handle_consensus_timeout("s", pid, NOW + 100) is False
+
+    def test_timeout_tie_fails(self):
+        """n=4, 2 yes 2 no, full participation would tie-break — but with
+        only 2 votes and liveness filling both ways we can craft a genuine
+        insufficient case: n=2 would be unanimity, so use threshold 1.0."""
+        engine = make_engine()
+        engine.scope("s").with_threshold(1.0).initialize()
+        pid = engine.create_proposal("s", request(4, liveness=True), NOW).proposal_id
+        receiver = engine.event_bus().subscribe()
+        # 2 YES, 2 NO from four voters: yes_w = 2, no_w = 2, tot==n -> tie ->
+        # liveness YES. For a FAILED outcome use liveness=False and a split
+        # that reaches neither bar: threshold 1.0 means req=4.
+        signers = [random_stub_signer() for _ in range(2)]
+        for i, signer in enumerate(signers):
+            v = build_vote(engine.get_proposal("s", pid), i % 2 == 0, signer, NOW)
+            engine.process_incoming_vote("s", v, NOW)
+        with pytest.raises(InsufficientVotesAtTimeout):
+            engine.handle_consensus_timeout("s", pid, NOW + 100)
+        assert ("s", ConsensusFailedEvent(pid, NOW + 100)) in drain(receiver)
+
+    def test_timeout_idempotent_after_reached(self):
+        engine = make_engine()
+        pid = engine.create_proposal("s", request(3), NOW).proposal_id
+        engine.cast_vote("s", pid, True, NOW)
+        v = build_vote(engine.get_proposal("s", pid), True, random_stub_signer(), NOW)
+        engine.process_incoming_vote("s", v, NOW)
+        assert engine.handle_consensus_timeout("s", pid, NOW + 100) is True
+        assert engine.handle_consensus_timeout("s", pid, NOW + 200) is True
+
+    def test_sweep_timeouts(self):
+        engine = make_engine()
+        pid_a = engine.create_proposal("s", request(5, exp=50), NOW).proposal_id
+        pid_b = engine.create_proposal("s", request(5, exp=5000), NOW).proposal_id
+        engine.cast_vote("s", pid_a, True, NOW)
+        engine.cast_vote("s", pid_b, True, NOW)
+
+        swept = engine.sweep_timeouts(NOW + 100)
+        assert ("s", pid_a, True) in swept  # liveness fills YES
+        assert all(pid != pid_b for _, pid, _ in swept)  # not yet expired
+        assert engine.get_consensus_result("s", pid_b) is None
+
+
+class TestEngineBatchIngest:
+    def test_batch_across_sessions_and_scopes(self):
+        engine = make_engine()
+        pids = {}
+        for scope in ("a", "b"):
+            pids[scope] = engine.create_proposal(scope, request(3), NOW).proposal_id
+
+        items = []
+        for scope in ("a", "b"):
+            for _ in range(2):
+                vote = build_vote(
+                    engine.get_proposal(scope, pids[scope]),
+                    True,
+                    random_stub_signer(),
+                    NOW,
+                )
+                # Build sequentially so received_hash chains stay valid:
+                # apply each vote before building the next.
+                engine.process_incoming_vote(scope, vote, NOW)
+
+        assert engine.get_consensus_result("a", pids["a"]) is True
+        assert engine.get_consensus_result("b", pids["b"]) is True
+
+    def test_batch_statuses_unknown_and_invalid(self):
+        engine = make_engine()
+        pid = engine.create_proposal("s", request(3), NOW).proposal_id
+        good = build_vote(engine.get_proposal("s", pid), True, random_stub_signer(), NOW)
+        forged = build_vote(engine.get_proposal("s", pid), True, random_stub_signer(), NOW)
+        forged.signature = bytes(len(forged.signature))
+        unknown = good.clone()
+        unknown.proposal_id = pid ^ 0xFFFF
+
+        statuses = engine.ingest_votes(
+            [("s", good), ("s", forged), ("s", unknown)], NOW
+        )
+        assert statuses[0] == int(StatusCode.OK)
+        assert statuses[1] == int(StatusCode.INVALID_VOTE_SIGNATURE)
+        assert statuses[2] == int(StatusCode.SESSION_NOT_FOUND)
+
+    def test_voter_capacity_exhaustion(self):
+        engine = TpuConsensusEngine(
+            random_stub_signer(), capacity=4, voter_capacity=4
+        )
+        # Gossipsub accepts any number of distinct voters; lanes are the
+        # engine's physical bound.
+        pid = engine.create_proposal("s", request(4, liveness=False), NOW).proposal_id
+        statuses = []
+        for i in range(5):
+            vote = build_vote(
+                engine.get_proposal("s", pid),
+                False,
+                random_stub_signer(),
+                NOW,
+            )
+            statuses.append(engine.ingest_votes([("s", vote)], NOW)[0])
+        assert statuses[:4] == [int(StatusCode.OK)] * 3 + [int(StatusCode.ALREADY_REACHED)]
+        # 4th distinct voter hit ALREADY_REACHED (3 NO of 4 decided NO), the
+        # 5th never got a lane but the session being decided wins precedence
+        # in the scalar semantics; force the capacity error on an active one.
+        engine2 = TpuConsensusEngine(
+            random_stub_signer(), capacity=4, voter_capacity=3
+        )
+        engine2.scope("s").with_threshold(1.0).initialize()
+        pid2 = engine2.create_proposal(
+            "s", request(3, liveness=False), NOW
+        ).proposal_id
+        # Y, N, N at threshold 1.0 (req=3): neither side reaches the bar and
+        # there is no tie, so the session stays ACTIVE with all lanes taken.
+        for i in range(3):
+            vote = build_vote(
+                engine2.get_proposal("s", pid2), i == 0, random_stub_signer(), NOW
+            )
+            assert engine2.ingest_votes([("s", vote)], NOW)[0] == int(StatusCode.OK)
+        extra = build_vote(
+            engine2.get_proposal("s", pid2), True, random_stub_signer(), NOW
+        )
+        assert engine2.ingest_votes([("s", extra)], NOW)[0] == int(
+            StatusCode.VOTER_CAPACITY_EXCEEDED
+        )
+        with pytest.raises(VoterCapacityExceeded):
+            engine2.process_incoming_vote("s", extra, NOW)
+
+
+class TestEngineLifecycle:
+    def test_eviction_beyond_scope_cap(self):
+        engine = TpuConsensusEngine(
+            random_stub_signer(), capacity=8, voter_capacity=4,
+            max_sessions_per_scope=2,
+        )
+        pids = [
+            engine.create_proposal("s", request(3, name=f"p{i}"), NOW + i).proposal_id
+            for i in range(4)
+        ]
+        stats = engine.get_scope_stats("s")
+        assert stats.total_sessions == 2
+        # Newest two survive.
+        assert engine.get_proposal("s", pids[3]) is not None
+        assert engine.get_proposal("s", pids[2]) is not None
+        with pytest.raises(SessionNotFound):
+            engine.get_proposal("s", pids[0])
+        # Evicted slots are reusable.
+        assert engine.pool().free_slots == 6
+
+    def test_pool_exhaustion(self):
+        engine = TpuConsensusEngine(
+            random_stub_signer(), capacity=2, voter_capacity=4
+        )
+        engine.create_proposal("a", request(3), NOW)
+        engine.create_proposal("b", request(3), NOW)
+        with pytest.raises(PoolFullError):
+            engine.create_proposal("c", request(3), NOW)
+
+    def test_delete_scope_frees_slots(self):
+        engine = make_engine()
+        for i in range(3):
+            engine.create_proposal("s", request(3, name=f"p{i}"), NOW)
+        engine.scope("s").with_network_type(NetworkType.P2P).initialize()
+        engine.delete_scope("s")
+        assert engine.get_scope_stats("s").total_sessions == 0
+        assert engine.get_scope_config("s") is None
+        assert engine.pool().free_slots == 64
+
+    def test_export_session_roundtrip(self):
+        engine = make_engine()
+        pid = engine.create_proposal("s", request(3), NOW).proposal_id
+        engine.cast_vote("s", pid, True, NOW)
+        session = engine.export_session("s", pid)
+        assert session.state.is_active
+        assert len(session.votes) == 1
+        assert session.proposal.round == 2  # gossipsub round bump
+
+
+class TestEngineServiceParity:
+    """Randomized side-by-side traces: engine vs scalar service."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_trace_parity(self, seed):
+        rng = np.random.default_rng(seed)
+        service = make_service()
+        engine = TpuConsensusEngine(
+            service.signer(), capacity=64, voter_capacity=16,
+        )
+        service_rx = service.event_bus().subscribe()
+        engine_rx = engine.event_bus().subscribe()
+
+        # Shared voters with deterministic identities.
+        voters = [random_stub_signer() for _ in range(8)]
+        scopes = ["alpha", "beta"]
+        for scope in scopes:
+            if rng.random() < 0.5:
+                service.scope(scope).with_network_type(NetworkType.P2P).initialize()
+                engine.scope(scope).with_network_type(NetworkType.P2P).initialize()
+
+        pids: list[tuple[str, int]] = []
+        for step in range(60):
+            now = NOW + step
+            action = rng.random()
+            if action < 0.2 or not pids:
+                scope = scopes[int(rng.integers(len(scopes)))]
+                n = int(rng.integers(2, 8))
+                live = bool(rng.random() < 0.5)
+                exp = int(rng.choice([30, 1000]))
+                req_obj = CreateProposalRequest(
+                    name=f"p{step}",
+                    payload=b"x",
+                    proposal_owner=b"o",
+                    expected_voters_count=n,
+                    expiration_timestamp=exp,
+                    liveness_criteria_yes=live,
+                )
+                proposal = req_obj.into_proposal(now)
+                # Drive both through process_incoming_proposal so they share
+                # one proposal_id.
+                s_exc = e_exc = None
+                try:
+                    service.process_incoming_proposal(scope, proposal.clone(), now)
+                except ConsensusError as exc:
+                    s_exc = type(exc)
+                try:
+                    engine.process_incoming_proposal(scope, proposal.clone(), now)
+                except ConsensusError as exc:
+                    e_exc = type(exc)
+                assert s_exc == e_exc, f"step {step} create: {s_exc} vs {e_exc}"
+                if s_exc is None:
+                    pids.append((scope, proposal.proposal_id))
+            elif action < 0.85:
+                scope, pid = pids[int(rng.integers(len(pids)))]
+                signer = voters[int(rng.integers(len(voters)))]
+                choice = bool(rng.random() < 0.6)
+                s_exc = e_exc = None
+                vote = None
+                try:
+                    base = service.storage().get_proposal(scope, pid)
+                    vote = build_vote(base, choice, signer, now)
+                except ConsensusError as exc:
+                    s_exc = type(exc)
+                if vote is not None:
+                    try:
+                        service.process_incoming_vote(scope, vote.clone(), now)
+                    except ConsensusError as exc:
+                        s_exc = type(exc)
+                    try:
+                        engine.process_incoming_vote(scope, vote.clone(), now)
+                    except ConsensusError as exc:
+                        e_exc = type(exc)
+                    assert s_exc == e_exc, (
+                        f"step {step} vote: service={s_exc} engine={e_exc}"
+                    )
+            else:
+                scope, pid = pids[int(rng.integers(len(pids)))]
+                s_exc = e_exc = None
+                s_res = e_res = None
+                try:
+                    s_res = service.handle_consensus_timeout(scope, pid, now)
+                except ConsensusError as exc:
+                    s_exc = type(exc)
+                try:
+                    e_res = engine.handle_consensus_timeout(scope, pid, now)
+                except ConsensusError as exc:
+                    e_exc = type(exc)
+                assert (s_res, s_exc) == (e_res, e_exc), f"step {step} timeout"
+
+        # Final state parity for every session both sides still track.
+        for scope, pid in pids:
+            s_session = service.storage().get_session(scope, pid)
+            if s_session is None:
+                with pytest.raises(SessionNotFound):
+                    engine.get_proposal(scope, pid)
+                continue
+            e_session = engine.export_session(scope, pid)
+            assert e_session.state == s_session.state, f"{scope}/{pid} state"
+            assert set(e_session.votes) == set(s_session.votes), f"{scope}/{pid} voters"
+            assert e_session.proposal.round == s_session.proposal.round
+            for owner, vote in s_session.votes.items():
+                assert e_session.votes[owner].vote == vote.vote
+
+        # Event streams match exactly (order and payloads).
+        assert drain(service_rx) == drain(engine_rx)
