@@ -32,6 +32,7 @@ from ..errors import (
     SessionNotFound,
     StatusCode,
     UserAlreadyVoted,
+    VoterCapacityExceeded,
     error_for_code,
 )
 from ..events import BroadcastEventBus, ConsensusEventBus
@@ -149,8 +150,12 @@ class TpuConsensusEngine(Generic[Scope]):
         """Create a local proposal and claim a pool slot
         (reference: src/service.rs:183-209)."""
         proposal = request.into_proposal(now)
+        # Same gauntlet the scalar service runs via from_proposal ->
+        # validate_proposal (trivial for a fresh, vote-free proposal but
+        # keeps the error surface identical, reference: src/utils.rs:106-120).
+        validate_proposal_timestamp(proposal.expiration_timestamp, now)
         resolved = self._resolve_config(scope, config, proposal)
-        self._register(scope, proposal, resolved, now, state_code=STATE_ACTIVE)
+        self._register(scope, proposal, resolved, now)
         return proposal.clone()
 
     def process_incoming_proposal(
@@ -187,7 +192,6 @@ class TpuConsensusEngine(Generic[Scope]):
         proposal: Proposal,
         config: ConsensusConfig,
         now: int,
-        state_code: int,
     ) -> SessionRecord[Scope]:
         n = proposal.expected_voters_count
         threshold = config.consensus_threshold
@@ -211,8 +215,6 @@ class TpuConsensusEngine(Generic[Scope]):
         self._records[slot] = record
         self._index[(scope, proposal.proposal_id)] = slot
         self._scopes.setdefault(scope, []).append(slot)
-        if state_code != STATE_ACTIVE:
-            raise AssertionError("fresh registrations start ACTIVE")
         self._trim_scope(scope)
         return record
 
@@ -222,10 +224,15 @@ class TpuConsensusEngine(Generic[Scope]):
         """Load a replayed scalar session (possibly already decided) into a
         fresh slot."""
         proposal = session.proposal
-        record = self._register(scope, proposal, session.config, now, STATE_ACTIVE)
+        if len(session.votes) > self._pool.voter_capacity:
+            # Reject before touching the pool: nothing to roll back.
+            raise VoterCapacityExceeded(
+                "embedded vote chain exceeds pool voter capacity"
+            )
+        record = self._register(scope, proposal, session.config, now)
         if record.slot not in self._records:
             return  # evicted immediately by the per-scope cap (created_at tie)
-        record.votes = dict(session.votes)
+        record.votes = {k: v.clone() for k, v in session.votes.items()}
         if session.votes:
             meta = self._pool.meta(record.slot)
             vcap = self._pool.voter_capacity
@@ -233,10 +240,6 @@ class TpuConsensusEngine(Generic[Scope]):
             vals = np.zeros((1, vcap), bool)
             for owner, vote in session.votes.items():
                 lane = meta.lane_for(owner, vcap)
-                if lane is None:  # > V distinct voters in embedded chain
-                    raise ConsensusError(
-                        "embedded vote chain exceeds pool voter capacity"
-                    )
                 mask[0, lane] = True
                 vals[0, lane] = vote.vote
             state = {
@@ -357,8 +360,9 @@ class TpuConsensusEngine(Generic[Scope]):
             if dev_statuses[j] == int(StatusCode.OK):
                 scope, vote = items[i]
                 record = self._records[int(slots[j])]
-                record.votes[vote.vote_owner] = vote
-                record.proposal.votes.append(vote)
+                stored = vote.clone()  # as the scalar add_vote does
+                record.votes[stored.vote_owner] = stored
+                record.proposal.votes.append(stored)
                 record.bump_round(1)
                 last_ok[int(slots[j])] = j
 
@@ -423,10 +427,13 @@ class TpuConsensusEngine(Generic[Scope]):
         schedules per-proposal timers): fire the timeout decision for every
         still-undecided session whose expiration has passed, in one device
         dispatch. Returns (scope, proposal_id, result-or-None) per swept
-        session and emits the same events as per-session timeouts."""
+        session and emits the same events as per-session timeouts. Only
+        ACTIVE sessions are swept: a FAILED session's tallies are frozen (the
+        ingest kernel rejects votes on non-ACTIVE slots) so re-sweeping it
+        would deterministically re-fail and re-emit forever."""
         expired: list[int] = []
         for slot, record in self._records.items():
-            if self._pool.state_of(slot) in (STATE_ACTIVE, STATE_FAILED):
+            if self._pool.state_of(slot) == STATE_ACTIVE:
                 if self._pool.meta(slot).expiry <= now:
                     expired.append(slot)
         out: list[tuple[Scope, int, bool | None]] = []

@@ -48,6 +48,20 @@ class PoolFullError(RuntimeError):
     """The pool has no free slots (capacity P exhausted)."""
 
 
+def _bucket(size: int, floor: int = 8) -> int:
+    """Round a batch dimension up to a power-of-two bucket so XLA compiles
+    one program per bucket, not one per batch shape. Pad entries use the
+    out-of-range slot sentinel (scatters drop, gathers clip) or
+    ``valid=False`` cells, so padding is semantically inert."""
+    return max(floor, 1 << max(size - 1, 0).bit_length())
+
+
+def _pad_slot_ids(slots: np.ndarray, bucket: int, sentinel: int) -> np.ndarray:
+    out = np.full(bucket, sentinel, np.int32)
+    out[: len(slots)] = slots
+    return out
+
+
 @dataclass
 class SlotMeta:
     """Host-side bookkeeping for one allocated slot."""
@@ -233,7 +247,15 @@ class ProposalPool:
                 f"need {count} slots, {len(self._free)} free of {self.capacity}"
             )
         slots = [self._free.pop() for _ in range(count)]
-        slot_ids = jnp.asarray(np.asarray(slots, np.int32))
+        bucket = _bucket(count)
+        slot_ids = jnp.asarray(
+            _pad_slot_ids(np.asarray(slots, np.int32), bucket, self.capacity)
+        )
+        pad1 = lambda arr, dtype: jnp.asarray(
+            np.concatenate(
+                [np.asarray(arr, dtype), np.zeros(bucket - count, dtype)]
+            )
+        )
 
         (
             self._state,
@@ -258,11 +280,11 @@ class ProposalPool:
             self._gossip,
             self._liveness,
             slot_ids,
-            jnp.asarray(n),
-            jnp.asarray(np.asarray(req, np.int32)),
-            jnp.asarray(np.asarray(cap, np.int32)),
-            jnp.asarray(np.asarray(gossip, bool)),
-            jnp.asarray(np.asarray(liveness, bool)),
+            pad1(n, np.int32),
+            pad1(req, np.int32),
+            pad1(cap, np.int32),
+            pad1(gossip, bool),
+            pad1(liveness, bool),
         )
 
         expiry = np.asarray(expiry, np.int64)
@@ -286,7 +308,24 @@ class ProposalPool:
         """Overwrite tallies of already-allocated slots (snapshot restore)."""
         if not slots:
             return
-        slot_ids = jnp.asarray(np.asarray(slots, np.int32))
+        count = len(slots)
+        bucket = _bucket(count)
+        slot_ids = jnp.asarray(
+            _pad_slot_ids(np.asarray(slots, np.int32), bucket, self.capacity)
+        )
+        pad1 = lambda arr, dtype: jnp.asarray(
+            np.concatenate(
+                [np.asarray(arr, dtype), np.zeros(bucket - count, dtype)]
+            )
+        )
+        pad2 = lambda arr: jnp.asarray(
+            np.concatenate(
+                [
+                    np.asarray(arr, bool),
+                    np.zeros((bucket - count, self.voter_capacity), bool),
+                ]
+            )
+        )
         (
             self._state,
             self._yes,
@@ -300,11 +339,11 @@ class ProposalPool:
             self._vote_mask,
             self._vote_val,
             slot_ids,
-            jnp.asarray(np.asarray(state, np.int32)),
-            jnp.asarray(np.asarray(yes, np.int32)),
-            jnp.asarray(np.asarray(tot, np.int32)),
-            jnp.asarray(np.asarray(mask_rows, bool)),
-            jnp.asarray(np.asarray(val_rows, bool)),
+            pad1(state, np.int32),
+            pad1(yes, np.int32),
+            pad1(tot, np.int32),
+            pad2(mask_rows),
+            pad2(val_rows),
         )
         self._state_host[np.asarray(slots)] = np.asarray(state, np.int32)
 
@@ -314,7 +353,14 @@ class ProposalPool:
         if not slots:
             return
         self._state = _release_kernel(
-            self._state, jnp.asarray(np.asarray(slots, np.int32))
+            self._state,
+            jnp.asarray(
+                _pad_slot_ids(
+                    np.asarray(slots, np.int32),
+                    _bucket(len(slots)),
+                    self.capacity,
+                )
+            ),
         )
         for slot in slots:
             self._state_host[slot] = STATE_FREE
@@ -349,18 +395,22 @@ class ProposalPool:
             return np.empty(0, np.int32), []
         uniq, row, col, depth = group_batch(slots)
         s_count = len(uniq)
-        voter_grid = np.zeros((s_count, depth), np.int32)
-        val_grid = np.zeros((s_count, depth), bool)
-        valid_grid = np.zeros((s_count, depth), bool)
+        bucket_s = _bucket(s_count)
+        bucket_l = _bucket(depth, floor=1)
+        voter_grid = np.zeros((bucket_s, bucket_l), np.int32)
+        val_grid = np.zeros((bucket_s, bucket_l), bool)
+        valid_grid = np.zeros((bucket_s, bucket_l), bool)
         voter_grid[row, col] = np.asarray(lanes, np.int32)
         val_grid[row, col] = np.asarray(values, bool)
         valid_grid[row, col] = True
+        slot_ids = _pad_slot_ids(uniq.astype(np.int32), bucket_s, self.capacity)
 
         expiry = np.array(
             [self._meta[s].expiry if s in self._meta else 0 for s in uniq],
             np.int64,
         )
-        expired = expiry <= now
+        expired = np.zeros(bucket_s, bool)
+        expired[:s_count] = expiry <= now
 
         (
             self._state,
@@ -381,14 +431,14 @@ class ProposalPool:
             self._cap,
             self._gossip,
             self._liveness,
-            jnp.asarray(uniq.astype(np.int32)),
+            jnp.asarray(slot_ids),
             jnp.asarray(expired),
             jnp.asarray(voter_grid),
             jnp.asarray(val_grid),
             jnp.asarray(valid_grid),
         )
         statuses = np.asarray(statuses)
-        row_state = np.asarray(row_state)
+        row_state = np.asarray(row_state)[:s_count]
 
         transitions: list[tuple[int, int]] = []
         for i, slot in enumerate(uniq):
@@ -408,7 +458,10 @@ class ProposalPool:
         """
         if not slots:
             return []
-        slot_ids = jnp.asarray(np.asarray(slots, np.int32))
+        bucket = _bucket(len(slots))
+        slot_ids = jnp.asarray(
+            _pad_slot_ids(np.asarray(slots, np.int32), bucket, self.capacity)
+        )
         self._state, row_state = timeout_kernel(
             self._state,
             self._yes,
@@ -418,7 +471,7 @@ class ProposalPool:
             self._liveness,
             slot_ids,
         )
-        row_state = np.asarray(row_state)
+        row_state = np.asarray(row_state)[: len(slots)]
         out: list[tuple[int, int]] = []
         for i, slot in enumerate(slots):
             new_state = int(row_state[i])
