@@ -107,15 +107,29 @@ class TpuConsensusEngine(Generic[Scope]):
         self,
         signer: ConsensusSignatureScheme,
         event_bus: ConsensusEventBus[Scope] | None = None,
-        capacity: int = 4096,
-        voter_capacity: int = 64,
+        capacity: int | None = None,
+        voter_capacity: int | None = None,
         max_sessions_per_scope: int = DEFAULT_MAX_SESSIONS_PER_SCOPE,
+        pool: ProposalPool | None = None,
     ):
         self._signer = signer
         self._event_bus: ConsensusEventBus[Scope] = (
             event_bus if event_bus is not None else BroadcastEventBus()
         )
-        self._pool = ProposalPool(capacity, voter_capacity)
+        # An injected pool (e.g. parallel.ShardedPool over a device mesh)
+        # swaps the execution substrate without touching engine semantics.
+        if pool is not None:
+            if capacity is not None or voter_capacity is not None:
+                raise ValueError(
+                    "pass capacity/voter_capacity OR an explicit pool, not "
+                    "both (the pool's own geometry wins)"
+                )
+            self._pool = pool
+        else:
+            self._pool = ProposalPool(
+                capacity if capacity is not None else 4096,
+                voter_capacity if voter_capacity is not None else 64,
+            )
         self._max_sessions_per_scope = max_sessions_per_scope
 
         self._records: dict[int, SessionRecord[Scope]] = {}  # slot -> record

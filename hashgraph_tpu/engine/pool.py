@@ -9,15 +9,18 @@ cannot express with static shapes — the free list, slot↔proposal mapping,
 owner-bytes→voter-lane dictionaries, and expiry timestamps.
 
 Design notes (TPU):
-- fixed capacity: slot allocation/eviction churn never changes array shapes,
-  so every kernel compiles once per pool geometry;
+- fixed capacity + power-of-two batch buckets: array shapes never vary with
+  load, so each kernel compiles once per (pool geometry, bucket);
 - buffer donation on every mutation: the pool state is updated in place in
   HBM, no copy-on-write traffic;
 - readbacks are narrow: ingest returns per-vote statuses and touched-slot
   states only; full-row gathers (:meth:`ProposalPool.read_slot`) are a cold
   query path;
 - the host mirrors the ``state`` vector (updated from kernel readbacks, never
-  re-fetched) so stats and transition detection cost no device traffic.
+  re-fetched) so stats and transition detection cost no device traffic;
+- device work is isolated behind ``_dispatch_*`` hooks: the multi-device pool
+  (:mod:`hashgraph_tpu.parallel`) overrides only those, inheriting all host
+  bookkeeping.
 """
 
 from __future__ import annotations
@@ -33,13 +36,10 @@ import jax.numpy as jnp
 
 from ..ops.decide import (
     STATE_ACTIVE,
-    STATE_FAILED,
     STATE_FREE,
-    STATE_REACHED_NO,
-    STATE_REACHED_YES,
     timeout_kernel,
 )
-from ..ops.ingest import group_batch, ingest_kernel
+from ..ops.ingest import group_batch, ingest_kernel, pack_grid, pack_slots
 
 __all__ = ["ProposalPool", "SlotMeta", "PoolFullError"]
 
@@ -62,6 +62,32 @@ def _pad_slot_ids(slots: np.ndarray, bucket: int, sentinel: int) -> np.ndarray:
     return out
 
 
+def _pad1(arr: np.ndarray, bucket: int, dtype) -> np.ndarray:
+    out = np.zeros(bucket, dtype)
+    out[: len(arr)] = np.asarray(arr, dtype)
+    return out
+
+
+def _pad2(arr: np.ndarray, rows: int, cols: int, dtype) -> np.ndarray:
+    out = np.zeros((rows, cols), dtype)
+    out[: arr.shape[0], : arr.shape[1]] = arr
+    return out
+
+
+@dataclass
+class PendingIngest:
+    """An in-flight ingest dispatch: the device output plus the host-side
+    coordinates needed to interpret it. Lets callers pipeline many dispatches
+    (device work and transfers overlap) and pay the readback latency once —
+    essential on latency-bound links (tunneled TPUs: ~100ms per sync)."""
+
+    out: object  # device int32[rows, L+1]: statuses + final row state
+    uniq: np.ndarray  # [S] touched slots
+    row: np.ndarray  # [B] batch item -> grid row
+    col: np.ndarray  # [B] batch item -> grid col
+    row_select: np.ndarray  # routed-row indexer: out[row_select] -> [S, :]
+
+
 @dataclass
 class SlotMeta:
     """Host-side bookkeeping for one allocated slot."""
@@ -75,8 +101,9 @@ class SlotMeta:
         """Owner-bytes → voter-lane dictionary (SURVEY §7: duplicate-owner
         detection needs exact bytes, not a hash that could collide). Returns
         None when all V lanes are taken by *other* owners — the protocol
-        bounds distinct voters by expected_voters_count ≤ V, so this only
-        happens for votes that would be rejected anyway."""
+        bounds distinct voters by expected_voters_count ≤ V in P2P mode;
+        Gossipsub mode accepts arbitrarily many distinct voters, so size V
+        accordingly."""
         lane = self.voter_lanes.get(owner)
         if lane is None:
             if len(self.voter_lanes) >= capacity:
@@ -86,8 +113,7 @@ class SlotMeta:
         return lane
 
 
-@partial(jax.jit, donate_argnums=tuple(range(10)))
-def _activate_kernel(
+def activate_body(
     state,
     yes,
     tot,
@@ -105,7 +131,8 @@ def _activate_kernel(
     gossip_new,
     live_new,
 ):
-    """Claim slots for new proposals: reset tallies, write per-slot config."""
+    """Claim slots for new proposals: reset tallies, write per-slot config.
+    (Body form reused inside shard_map blocks by the multi-device pool.)"""
     put = lambda arr, val: arr.at[slot_ids].set(val, mode="drop")
     state = put(state, STATE_ACTIVE)
     yes = put(yes, 0)
@@ -120,8 +147,7 @@ def _activate_kernel(
     return state, yes, tot, vote_mask, vote_val, n, req, cap, gossip, liveness
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
-def _load_kernel(
+def load_body(
     state,
     yes,
     tot,
@@ -148,9 +174,13 @@ def _load_kernel(
     )
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _release_kernel(state, slot_ids):
+def release_body(state, slot_ids):
     return state.at[slot_ids].set(STATE_FREE, mode="drop")
+
+
+_activate_kernel = partial(jax.jit, donate_argnums=tuple(range(10)))(activate_body)
+_load_kernel = partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))(load_body)
+_release_kernel = partial(jax.jit, donate_argnums=(0,))(release_body)
 
 
 @jax.jit
@@ -173,22 +203,30 @@ class ProposalPool:
             raise ValueError("capacity and voter_capacity must be >= 1")
         self.capacity = capacity
         self.voter_capacity = voter_capacity
-
-        self._state = jnp.full(capacity, STATE_FREE, jnp.int32)
-        self._yes = jnp.zeros(capacity, jnp.int32)
-        self._tot = jnp.zeros(capacity, jnp.int32)
-        self._vote_mask = jnp.zeros((capacity, voter_capacity), bool)
-        self._vote_val = jnp.zeros((capacity, voter_capacity), bool)
-        self._n = jnp.zeros(capacity, jnp.int32)
-        self._req = jnp.zeros(capacity, jnp.int32)
-        self._cap = jnp.zeros(capacity, jnp.int32)
-        self._gossip = jnp.zeros(capacity, bool)
-        self._liveness = jnp.zeros(capacity, bool)
+        self._init_device_arrays()
 
         # Host mirrors / bookkeeping.
         self._state_host = np.full(capacity, STATE_FREE, np.int32)
+        self._expiry_host = np.zeros(capacity, np.int64)
         self._free: list[int] = list(range(capacity - 1, -1, -1))
         self._meta: dict[int, SlotMeta] = {}
+        # Pipelining discipline: host mirror updates must apply in dispatch
+        # order, and no other mutation may interleave with in-flight ingests
+        # (the mirror would desync from the device). Enforced, not documented.
+        self._inflight: list[PendingIngest] = []
+
+    def _init_device_arrays(self) -> None:
+        p, v = self.capacity, self.voter_capacity
+        self._state = jnp.full(p, STATE_FREE, jnp.int32)
+        self._yes = jnp.zeros(p, jnp.int32)
+        self._tot = jnp.zeros(p, jnp.int32)
+        self._vote_mask = jnp.zeros((p, v), bool)
+        self._vote_val = jnp.zeros((p, v), bool)
+        self._n = jnp.zeros(p, jnp.int32)
+        self._req = jnp.zeros(p, jnp.int32)
+        self._cap = jnp.zeros(p, jnp.int32)
+        self._gossip = jnp.zeros(p, bool)
+        self._liveness = jnp.zeros(p, bool)
 
     # ── Introspection ──────────────────────────────────────────────────
 
@@ -236,6 +274,7 @@ class ProposalPool:
         count = len(keys)
         if count == 0:
             return []
+        self._check_no_inflight("allocate_batch")
         n = np.asarray(n, np.int32)
         if int(n.max()) > self.voter_capacity:
             raise ValueError(
@@ -247,16 +286,197 @@ class ProposalPool:
                 f"need {count} slots, {len(self._free)} free of {self.capacity}"
             )
         slots = [self._free.pop() for _ in range(count)]
-        bucket = _bucket(count)
-        slot_ids = jnp.asarray(
-            _pad_slot_ids(np.asarray(slots, np.int32), bucket, self.capacity)
-        )
-        pad1 = lambda arr, dtype: jnp.asarray(
-            np.concatenate(
-                [np.asarray(arr, dtype), np.zeros(bucket - count, dtype)]
-            )
+        self._dispatch_activate(
+            np.asarray(slots, np.int32),
+            n,
+            np.asarray(req, np.int32),
+            np.asarray(cap, np.int32),
+            np.asarray(gossip, bool),
+            np.asarray(liveness, bool),
         )
 
+        expiry = np.asarray(expiry, np.int64)
+        created_at = np.asarray(created_at, np.int64)
+        for i, slot in enumerate(slots):
+            self._state_host[slot] = STATE_ACTIVE
+            self._expiry_host[slot] = expiry[i]
+            self._meta[slot] = SlotMeta(
+                key=keys[i], expiry=int(expiry[i]), created_at=int(created_at[i])
+            )
+        return slots
+
+    def load_rows(
+        self,
+        slots: list[int],
+        state: np.ndarray,
+        yes: np.ndarray,
+        tot: np.ndarray,
+        mask_rows: np.ndarray,
+        val_rows: np.ndarray,
+    ) -> None:
+        """Overwrite tallies of already-allocated slots (snapshot restore)."""
+        if not slots:
+            return
+        self._check_no_inflight("load_rows")
+        self._dispatch_load(
+            np.asarray(slots, np.int32),
+            np.asarray(state, np.int32),
+            np.asarray(yes, np.int32),
+            np.asarray(tot, np.int32),
+            np.asarray(mask_rows, bool),
+            np.asarray(val_rows, bool),
+        )
+        self._state_host[np.asarray(slots)] = np.asarray(state, np.int32)
+
+    def release(self, slots: list[int]) -> None:
+        """Return slots to the free list (eviction / delete_scope). Tallies
+        are lazily cleared on the next allocation of the slot."""
+        if not slots:
+            return
+        self._check_no_inflight("release")
+        self._dispatch_release(np.asarray(slots, np.int32))
+        for slot in slots:
+            self._state_host[slot] = STATE_FREE
+            self._expiry_host[slot] = 0
+            del self._meta[slot]
+            self._free.append(slot)
+
+    # ── Hot paths ──────────────────────────────────────────────────────
+
+    def ingest(
+        self,
+        slots: np.ndarray,
+        lanes: np.ndarray,
+        values: np.ndarray,
+        now: int,
+    ) -> tuple[np.ndarray, list[tuple[int, int]]]:
+        """Apply a flat, arrival-ordered vote batch (synchronous).
+
+        Args:
+          slots: int64[B] target slot per vote.
+          lanes: int32[B] voter lane per vote (from SlotMeta.lane_for).
+          values: bool[B] the yes/no choices.
+          now: caller clock, for the per-slot expiry check
+            (reference: src/session.rs:226).
+
+        Returns:
+          (statuses int32[B] in batch order, transitions) where transitions
+          lists (slot, new_state) for every slot whose lifecycle state
+          changed — the engine turns these into ConsensusReached events.
+        """
+        pending = self.ingest_async(slots, lanes, values, now)
+        if pending is None:
+            return np.empty(0, np.int32), []
+        return self.complete(pending)
+
+    def ingest_async(
+        self,
+        slots: np.ndarray,
+        lanes: np.ndarray,
+        values: np.ndarray,
+        now: int,
+    ) -> PendingIngest | None:
+        """Dispatch a vote batch without waiting for results.
+
+        The pool arrays advance immediately (donated in-place on device), so
+        subsequent dispatches chain correctly; statuses/transitions become
+        visible when :meth:`complete` is called. Streaming callers keep
+        several batches in flight to hide host↔device latency (the pipeline
+        axis from SURVEY §2.3).
+        """
+        slots = np.asarray(slots, np.int64)
+        if slots.size == 0:
+            return None
+        uniq, row, col, depth = group_batch(slots)
+        s_count = len(uniq)
+        voter_grid = np.zeros((s_count, depth), np.int32)
+        valbit = np.zeros((s_count, depth), np.int32)
+        voter_grid[row, col] = np.asarray(lanes, np.int32)
+        valbit[row, col] = np.asarray(values, np.int32) | 2  # value | valid
+        grid = pack_grid(voter_grid, valbit & 1, valbit >> 1)
+
+        expired = self._expiry_host[uniq] <= now
+        out, row_select = self._dispatch_ingest(
+            pack_slots(uniq.astype(np.int32), expired), grid
+        )
+        pending = PendingIngest(
+            out=out, uniq=uniq, row=row, col=col, row_select=row_select
+        )
+        self._inflight.append(pending)
+        return pending
+
+    def complete_all(
+        self, pendings: list[PendingIngest]
+    ) -> list[tuple[np.ndarray, list[tuple[int, int]]]]:
+        """Block on many in-flight ingests with ONE host↔device round-trip
+        (jax.device_get batches the transfers — on a latency-bound link this
+        is the difference between paying ~100ms once vs once per batch).
+        Must be called in dispatch order (enforced)."""
+        outs = jax.device_get([p.out for p in pendings])
+        return [
+            self._finish(pending, out) for pending, out in zip(pendings, outs)
+        ]
+
+    def complete(
+        self, pending: PendingIngest
+    ) -> tuple[np.ndarray, list[tuple[int, int]]]:
+        """Block on an in-flight ingest; return (statuses[B], transitions)."""
+        return self._finish(pending, np.asarray(pending.out))
+
+    def _check_no_inflight(self, op: str) -> None:
+        if self._inflight:
+            raise RuntimeError(
+                f"{op} while {len(self._inflight)} ingest dispatch(es) are "
+                "in flight: complete() them first (the host state mirror "
+                "must apply updates in dispatch order)"
+            )
+
+    def _finish(
+        self, pending: PendingIngest, host_out: np.ndarray
+    ) -> tuple[np.ndarray, list[tuple[int, int]]]:
+        if not self._inflight or self._inflight[0] is not pending:
+            raise RuntimeError(
+                "ingest completions must happen in dispatch order"
+            )
+        self._inflight.pop(0)
+        arr = host_out[pending.row_select]
+        statuses = arr[:, :-1]
+        row_state = arr[:, -1]
+        prev = self._state_host[pending.uniq]
+        changed = prev != row_state
+        self._state_host[pending.uniq] = row_state
+        transitions = list(
+            zip(
+                pending.uniq[changed].tolist(),
+                row_state[changed].tolist(),
+            )
+        )
+        return statuses[pending.row, pending.col], transitions
+
+    def timeout(self, slots: list[int]) -> list[tuple[int, int]]:
+        """Fire the timeout decision for the given slots.
+
+        Returns (slot, new_state) for each *requested* slot after the sweep
+        (including unchanged already-decided ones, so the caller can
+        implement the reference's idempotent timeout return,
+        src/service.rs:331-334).
+        """
+        if not slots:
+            return []
+        self._check_no_inflight("timeout")
+        row_state = self._dispatch_timeout(np.asarray(slots, np.int32))
+        out: list[tuple[int, int]] = []
+        for i, slot in enumerate(slots):
+            new_state = int(row_state[i])
+            self._state_host[slot] = new_state
+            out.append((int(slot), new_state))
+        return out
+
+    # ── Device dispatch (single-device; overridden by the sharded pool) ─
+
+    def _dispatch_activate(self, slots, n, req, cap, gossip, liveness) -> None:
+        bucket = _bucket(len(slots))
+        slot_ids = jnp.asarray(_pad_slot_ids(slots, bucket, self.capacity))
         (
             self._state,
             self._yes,
@@ -280,52 +500,16 @@ class ProposalPool:
             self._gossip,
             self._liveness,
             slot_ids,
-            pad1(n, np.int32),
-            pad1(req, np.int32),
-            pad1(cap, np.int32),
-            pad1(gossip, bool),
-            pad1(liveness, bool),
+            jnp.asarray(_pad1(n, bucket, np.int32)),
+            jnp.asarray(_pad1(req, bucket, np.int32)),
+            jnp.asarray(_pad1(cap, bucket, np.int32)),
+            jnp.asarray(_pad1(gossip, bucket, bool)),
+            jnp.asarray(_pad1(liveness, bucket, bool)),
         )
 
-        expiry = np.asarray(expiry, np.int64)
-        created_at = np.asarray(created_at, np.int64)
-        for i, slot in enumerate(slots):
-            self._state_host[slot] = STATE_ACTIVE
-            self._meta[slot] = SlotMeta(
-                key=keys[i], expiry=int(expiry[i]), created_at=int(created_at[i])
-            )
-        return slots
-
-    def load_rows(
-        self,
-        slots: list[int],
-        state: np.ndarray,
-        yes: np.ndarray,
-        tot: np.ndarray,
-        mask_rows: np.ndarray,
-        val_rows: np.ndarray,
-    ) -> None:
-        """Overwrite tallies of already-allocated slots (snapshot restore)."""
-        if not slots:
-            return
-        count = len(slots)
-        bucket = _bucket(count)
-        slot_ids = jnp.asarray(
-            _pad_slot_ids(np.asarray(slots, np.int32), bucket, self.capacity)
-        )
-        pad1 = lambda arr, dtype: jnp.asarray(
-            np.concatenate(
-                [np.asarray(arr, dtype), np.zeros(bucket - count, dtype)]
-            )
-        )
-        pad2 = lambda arr: jnp.asarray(
-            np.concatenate(
-                [
-                    np.asarray(arr, bool),
-                    np.zeros((bucket - count, self.voter_capacity), bool),
-                ]
-            )
-        )
+    def _dispatch_load(self, slots, state, yes, tot, mask_rows, val_rows) -> None:
+        bucket = _bucket(len(slots))
+        slot_ids = jnp.asarray(_pad_slot_ids(slots, bucket, self.capacity))
         (
             self._state,
             self._yes,
@@ -339,87 +523,32 @@ class ProposalPool:
             self._vote_mask,
             self._vote_val,
             slot_ids,
-            pad1(state, np.int32),
-            pad1(yes, np.int32),
-            pad1(tot, np.int32),
-            pad2(mask_rows),
-            pad2(val_rows),
+            jnp.asarray(_pad1(state, bucket, np.int32)),
+            jnp.asarray(_pad1(yes, bucket, np.int32)),
+            jnp.asarray(_pad1(tot, bucket, np.int32)),
+            jnp.asarray(_pad2(mask_rows, bucket, self.voter_capacity, bool)),
+            jnp.asarray(_pad2(val_rows, bucket, self.voter_capacity, bool)),
         )
-        self._state_host[np.asarray(slots)] = np.asarray(state, np.int32)
 
-    def release(self, slots: list[int]) -> None:
-        """Return slots to the free list (eviction / delete_scope). Tallies
-        are lazily cleared on the next allocation of the slot."""
-        if not slots:
-            return
+    def _dispatch_release(self, slots) -> None:
         self._state = _release_kernel(
             self._state,
-            jnp.asarray(
-                _pad_slot_ids(
-                    np.asarray(slots, np.int32),
-                    _bucket(len(slots)),
-                    self.capacity,
-                )
-            ),
+            jnp.asarray(_pad_slot_ids(slots, _bucket(len(slots)), self.capacity)),
         )
-        for slot in slots:
-            self._state_host[slot] = STATE_FREE
-            del self._meta[slot]
-            self._free.append(slot)
 
-    # ── Hot paths ──────────────────────────────────────────────────────
-
-    def ingest(
-        self,
-        slots: np.ndarray,
-        lanes: np.ndarray,
-        values: np.ndarray,
-        now: int,
-    ) -> tuple[np.ndarray, list[tuple[int, int]]]:
-        """Apply a flat, arrival-ordered vote batch.
-
-        Args:
-          slots: int64[B] target slot per vote.
-          lanes: int32[B] voter lane per vote (from SlotMeta.lane_for).
-          values: bool[B] the yes/no choices.
-          now: caller clock, for the per-slot expiry check
-            (reference: src/session.rs:226).
-
-        Returns:
-          (statuses int32[B] in batch order, transitions) where transitions
-          lists (slot, new_state) for every slot whose lifecycle state
-          changed — the engine turns these into ConsensusReached events.
-        """
-        slots = np.asarray(slots, np.int64)
-        if slots.size == 0:
-            return np.empty(0, np.int32), []
-        uniq, row, col, depth = group_batch(slots)
-        s_count = len(uniq)
+    def _dispatch_ingest(self, slot_pack, grid_pack):
+        """Dispatch the packed batch; returns (device out [B_s, L+1],
+        row-select indexer recovering the S real rows). Does NOT block."""
+        s_count, depth = grid_pack.shape
         bucket_s = _bucket(s_count)
         bucket_l = _bucket(depth, floor=1)
-        voter_grid = np.zeros((bucket_s, bucket_l), np.int32)
-        val_grid = np.zeros((bucket_s, bucket_l), bool)
-        valid_grid = np.zeros((bucket_s, bucket_l), bool)
-        voter_grid[row, col] = np.asarray(lanes, np.int32)
-        val_grid[row, col] = np.asarray(values, bool)
-        valid_grid[row, col] = True
-        slot_ids = _pad_slot_ids(uniq.astype(np.int32), bucket_s, self.capacity)
-
-        expiry = np.array(
-            [self._meta[s].expiry if s in self._meta else 0 for s in uniq],
-            np.int64,
-        )
-        expired = np.zeros(bucket_s, bool)
-        expired[:s_count] = expiry <= now
-
         (
             self._state,
             self._yes,
             self._tot,
             self._vote_mask,
             self._vote_val,
-            statuses,
-            row_state,
+            out,
         ) = ingest_kernel(
             self._state,
             self._yes,
@@ -431,37 +560,14 @@ class ProposalPool:
             self._cap,
             self._gossip,
             self._liveness,
-            jnp.asarray(slot_ids),
-            jnp.asarray(expired),
-            jnp.asarray(voter_grid),
-            jnp.asarray(val_grid),
-            jnp.asarray(valid_grid),
+            jnp.asarray(_pad_slot_ids(slot_pack, bucket_s, self.capacity)),
+            jnp.asarray(_pad2(grid_pack, bucket_s, bucket_l, np.int32)),
         )
-        statuses = np.asarray(statuses)
-        row_state = np.asarray(row_state)[:s_count]
+        return out, np.arange(s_count)
 
-        transitions: list[tuple[int, int]] = []
-        for i, slot in enumerate(uniq):
-            new_state = int(row_state[i])
-            if self._state_host[slot] != new_state:
-                self._state_host[slot] = new_state
-                transitions.append((int(slot), new_state))
-        return statuses[row, col], transitions
-
-    def timeout(self, slots: list[int]) -> list[tuple[int, int]]:
-        """Fire the timeout decision for the given slots.
-
-        Returns (slot, new_state) for each *requested* slot after the sweep
-        (including unchanged already-decided ones, so the caller can
-        implement the reference's idempotent timeout return,
-        src/service.rs:331-334).
-        """
-        if not slots:
-            return []
+    def _dispatch_timeout(self, slots) -> np.ndarray:
+        """Returns new row states, one per requested slot."""
         bucket = _bucket(len(slots))
-        slot_ids = jnp.asarray(
-            _pad_slot_ids(np.asarray(slots, np.int32), bucket, self.capacity)
-        )
         self._state, row_state = timeout_kernel(
             self._state,
             self._yes,
@@ -469,15 +575,9 @@ class ProposalPool:
             self._n,
             self._req,
             self._liveness,
-            slot_ids,
+            jnp.asarray(_pad_slot_ids(slots, bucket, self.capacity)),
         )
-        row_state = np.asarray(row_state)[: len(slots)]
-        out: list[tuple[int, int]] = []
-        for i, slot in enumerate(slots):
-            new_state = int(row_state[i])
-            self._state_host[slot] = new_state
-            out.append((int(slot), new_state))
-        return out
+        return np.asarray(row_state)[: len(slots)]
 
     # ── Cold query path ────────────────────────────────────────────────
 

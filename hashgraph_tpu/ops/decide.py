@@ -138,8 +138,7 @@ def state_result(state):
     return has_result, state == STATE_REACHED_YES
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def timeout_kernel(state, yes, tot, n, req, liveness, slot_ids):
+def timeout_body(state, yes, tot, n, req, liveness, slot_ids):
     """Fire the timeout decision for the given slots and return their new
     states.
 
@@ -152,3 +151,8 @@ def timeout_kernel(state, yes, tot, n, req, liveness, slot_ids):
     fires = jnp.zeros(state.shape, bool).at[slot_ids].set(True, mode="drop")
     new_state = timeout_update(state, yes, tot, n, req, liveness, fires)
     return new_state, jnp.take(new_state, slot_ids, mode="clip")
+
+
+# Jitted single-device entry point; the raw body is reused inside shard_map
+# blocks by the multi-device pool (hashgraph_tpu.parallel).
+timeout_kernel = partial(jax.jit, donate_argnums=(0,))(timeout_body)

@@ -16,9 +16,16 @@ not the batch size: breadth-heavy workloads (many proposals, few votes each)
 are nearly fully parallel; depth-heavy replays serialize only within a
 proposal, exactly like the protocol itself does.
 
-Padding contract: pad rows carry ``slot_id == P`` (out of range). Gathers
-clip (values unused), scatters drop — so pad rows can never corrupt slot 0.
-Pad cells within a real row have ``valid == False``.
+Transfer format (the host↔device link is latency-bound — a tunneled TPU pays
+~100ms per round-trip, so the batch crosses in TWO packed arrays and returns
+in ONE):
+- ``slot_pack`` int32[S]: slot id in bits 0-29, ``expired`` flag in bit 30.
+  Pad rows carry slot id == P (out of range): gathers clip (values unused),
+  scatters drop — so pad rows can never corrupt slot 0.
+- ``grid_pack`` int32[S, L]: voter lane in bits 0-15, vote value in bit 16,
+  cell-valid in bit 17. Pad cells within a real row have valid == 0.
+- output int32[S, L+1]: per-vote statuses in columns [0, L), the row's final
+  lifecycle state in column L.
 """
 
 from __future__ import annotations
@@ -42,6 +49,37 @@ from .decide import (
 
 # Status emitted for padding cells (no vote present).
 PAD_STATUS = -1
+
+_SLOT_MASK = (1 << 30) - 1
+_EXPIRED_BIT = 30
+_LANE_MASK = (1 << 16) - 1
+_VAL_BIT = 16
+_VALID_BIT = 17
+
+
+def pack_slots(slot_ids: np.ndarray, expired: np.ndarray) -> np.ndarray:
+    """Host-side: fuse slot ids + expiry flags into one int32 transfer."""
+    return (
+        np.asarray(slot_ids, np.int32) | (np.asarray(expired, np.int32) << _EXPIRED_BIT)
+    ).astype(np.int32)
+
+
+def unpack_slots(slot_pack: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side inverse of :func:`pack_slots` (used by shard routing)."""
+    packed = np.asarray(slot_pack, np.int32)
+    return packed & _SLOT_MASK, ((packed >> _EXPIRED_BIT) & 1).astype(bool)
+
+
+def pack_grid(
+    voter_grid: np.ndarray, val_grid: np.ndarray, valid_grid: np.ndarray
+) -> np.ndarray:
+    """Host-side: fuse lane/value/valid grids into one int32 transfer."""
+    return (
+        np.asarray(voter_grid, np.int32)
+        | (np.asarray(val_grid, np.int32) << _VAL_BIT)
+        | (np.asarray(valid_grid, np.int32) << _VALID_BIT)
+    ).astype(np.int32)
+
 
 def group_batch(slot_idx: np.ndarray):
     """Host-side: group a flat vote batch by proposal slot into grid
@@ -77,8 +115,7 @@ _MAX_ROUNDS_EXCEEDED = int(StatusCode.MAX_ROUNDS_EXCEEDED)
 _DUPLICATE_VOTE = int(StatusCode.DUPLICATE_VOTE)
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
-def ingest_kernel(
+def ingest_body(
     state,  # int32[P] slot lifecycle
     yes,  # int32[P] YES tally
     tot,  # int32[P] total tally
@@ -89,16 +126,19 @@ def ingest_kernel(
     cap,  # int32[P] max round limit (max_round_limit semantics)
     gossipsub,  # bool[P] gossipsub round semantics flag
     liveness,  # bool[P] silent-peers-as-YES flag
-    slot_ids,  # int32[S] touched slots (P = pad sentinel)
-    expired,  # bool[S] host-computed `now >= expiration` per touched slot
-    voter_grid,  # int32[S, L] voter index within [0, V)
-    val_grid,  # bool[S, L] vote choice
-    valid_grid,  # bool[S, L] cell-is-a-real-vote mask
+    slot_pack,  # int32[S] packed slot ids + expired flags (see module doc)
+    grid_pack,  # int32[S, L] packed voter/value/valid cells
 ):
-    """Returns (updated pool arrays..., statuses int32[S, L], final row state
-    int32[S])."""
-    s_count = slot_ids.shape[0]
+    """Returns (updated pool arrays..., out int32[S, L+1]) where out carries
+    per-vote statuses plus the final row state in the last column."""
+    s_count = slot_pack.shape[0]
     rows = jnp.arange(s_count)
+
+    slot_ids = slot_pack & _SLOT_MASK
+    expired = ((slot_pack >> _EXPIRED_BIT) & 1).astype(bool)
+    voter_grid = grid_pack & _LANE_MASK
+    val_grid = ((grid_pack >> _VAL_BIT) & 1).astype(bool)
+    valid_grid = ((grid_pack >> _VALID_BIT) & 1).astype(bool)
 
     gather = lambda arr: jnp.take(arr, slot_ids, axis=0, mode="clip")
     row_state = gather(state)
@@ -181,4 +221,10 @@ def ingest_kernel(
     vote_mask = scatter(vote_mask, row_mask)
     vote_val = scatter(vote_val, row_val)
 
-    return state, yes, tot, vote_mask, vote_val, statuses, row_state
+    out = jnp.concatenate([statuses, row_state[:, None]], axis=1)
+    return state, yes, tot, vote_mask, vote_val, out
+
+
+# Jitted single-device entry point; the raw body is reused inside shard_map
+# blocks by the multi-device pool (hashgraph_tpu.parallel).
+ingest_kernel = partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))(ingest_body)

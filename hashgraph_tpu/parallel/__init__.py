@@ -1,0 +1,12 @@
+"""Multi-device parallelism: mesh construction + the sharded proposal pool.
+
+The slot axis is the framework's data-parallel axis (proposals are
+independent); voter lanes stay within a device (the per-proposal ``[V]``
+vectors are small); the host-validate → device-tally split is the pipeline
+axis. Collectives (psum over ICI) appear only in global aggregation.
+"""
+
+from .mesh import PROPOSAL_AXIS, consensus_mesh
+from .sharded import ShardedPool
+
+__all__ = ["consensus_mesh", "ShardedPool", "PROPOSAL_AXIS"]

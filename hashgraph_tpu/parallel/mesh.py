@@ -1,0 +1,36 @@
+"""Device-mesh helpers for the sharded consensus pool.
+
+The framework's unit of parallelism is the proposal: every proposal slot is
+independent (no cross-proposal dataflow in the protocol — the reference
+partitions state the same way by scope/proposal, src/storage.rs:188-194), so
+the natural mesh is one axis over all devices with the slot axis sharded
+across it. Collectives are needed only for global aggregation (stats), which
+rides ICI as a psum.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+PROPOSAL_AXIS = "p"
+
+
+def consensus_mesh(
+    n_devices: int | None = None, axis_name: str = PROPOSAL_AXIS
+) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` devices (default: all).
+
+    On a v5e-8 this is the 8-chip ICI ring; under
+    ``--xla_force_host_platform_device_count=N`` it is N virtual CPU devices
+    (how tests and the driver's multi-chip dry run exercise the sharded path
+    without TPU hardware).
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:n_devices]
+    return Mesh(devices, (axis_name,))

@@ -23,7 +23,13 @@ from hashgraph_tpu.ops import (
     STATE_REACHED_YES,
     required_votes_np,
 )
-from hashgraph_tpu.ops.ingest import PAD_STATUS, group_batch, ingest_kernel
+from hashgraph_tpu.ops.ingest import (
+    PAD_STATUS,
+    group_batch,
+    ingest_kernel,
+    pack_grid,
+    pack_slots,
+)
 from hashgraph_tpu.session import ConsensusSession
 from hashgraph_tpu.wire import Vote
 
@@ -134,14 +140,12 @@ def run_ingest(pool, slots, voters, vals, now):
         jnp.asarray(pool["cap"]),
         jnp.asarray(pool["gossip"]),
         jnp.asarray(pool["liveness"]),
-        jnp.asarray(uniq, jnp.int32),
-        jnp.asarray(expired),
-        jnp.asarray(voter_grid),
-        jnp.asarray(val_grid),
-        jnp.asarray(valid_grid),
+        jnp.asarray(pack_slots(uniq.astype(np.int32), expired)),
+        jnp.asarray(pack_grid(voter_grid, val_grid, valid_grid)),
     )
-    state, yes, tot, vote_mask, vote_val, statuses, row_state = map(np.asarray, out)
+    state, yes, tot, vote_mask, vote_val, packed_out = map(np.asarray, out)
     pool.update(state=state, yes=yes, tot=tot, vote_mask=vote_mask, vote_val=vote_val)
+    statuses = packed_out[:, :-1]
     return statuses[row, col]
 
 
@@ -260,13 +264,19 @@ class TestIngestParity:
             jnp.asarray(pool["cap"]),
             jnp.asarray(pool["gossip"]),
             jnp.asarray(pool["liveness"]),
-            jnp.asarray([0, p_count], jnp.int32),
-            jnp.asarray([False, False]),
-            jnp.asarray([[0], [0]], jnp.int32),
-            jnp.asarray([[True], [True]]),
-            jnp.asarray([[True], [False]]),  # pad row: all cells invalid
+            jnp.asarray(
+                pack_slots(np.array([0, p_count], np.int32), np.array([False, False]))
+            ),
+            jnp.asarray(
+                pack_grid(
+                    np.array([[0], [0]], np.int32),
+                    np.array([[True], [True]]),
+                    np.array([[True], [False]]),  # pad row: all cells invalid
+                )
+            ),
         )
-        state, yes, tot, mask, vals, statuses, _ = map(np.asarray, out)
+        state, yes, tot, mask, vals, packed_out = map(np.asarray, out)
+        statuses = packed_out[:, :-1]
         assert statuses[0, 0] == int(StatusCode.OK)
         assert statuses[1, 0] == PAD_STATUS
         assert tot[0] == 1 and yes[0] == 1

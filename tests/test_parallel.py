@@ -1,0 +1,234 @@
+"""Sharded pool on the virtual 8-device CPU mesh.
+
+conftest.py forces ``--xla_force_host_platform_device_count=8`` before jax
+initializes, so these tests exercise real multi-device sharding + shard_map
+routing without TPU hardware. The bar is the same as for the single-device
+engine: observable behavior identical to the scalar service.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from hashgraph_tpu import (
+    ConsensusError,
+    CreateProposalRequest,
+    NetworkType,
+    SessionNotFound,
+    StatusCode,
+    build_vote,
+)
+from hashgraph_tpu.engine import TpuConsensusEngine
+from hashgraph_tpu.ops import STATE_ACTIVE, STATE_FREE, STATE_REACHED_YES
+from hashgraph_tpu.parallel import ShardedPool, consensus_mesh
+
+from common import NOW, make_service, random_stub_signer
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert jax.device_count() >= 8, "conftest must provide 8 virtual devices"
+    return consensus_mesh(8)
+
+
+def make_sharded_engine(mesh, per_device=8, voter_capacity=16, **kw):
+    pool = ShardedPool(per_device, voter_capacity, mesh)
+    return TpuConsensusEngine(random_stub_signer(), pool=pool, **kw)
+
+
+def request(n=3, name="prop", exp=1000, liveness=True):
+    return CreateProposalRequest(
+        name=name,
+        payload=b"payload",
+        proposal_owner=b"owner",
+        expected_voters_count=n,
+        expiration_timestamp=exp,
+        liveness_criteria_yes=liveness,
+    )
+
+
+class TestShardedPoolLayout:
+    def test_arrays_are_sharded(self, mesh):
+        pool = ShardedPool(8, 16, mesh)
+        assert pool.capacity == 64
+        sharding = pool._state.sharding
+        assert sharding.num_devices == 8
+        # [P, V] arrays shard on the slot axis only.
+        assert pool._vote_mask.sharding.spec[0] == "p"
+
+    def test_round_robin_allocation(self, mesh):
+        pool = ShardedPool(4, 8, mesh)
+        slots = pool.allocate_batch(
+            keys=[("s", i) for i in range(8)],
+            n=np.full(8, 3),
+            req=np.full(8, 2),
+            cap=np.full(8, 2),
+            gossip=np.ones(8, bool),
+            liveness=np.ones(8, bool),
+            expiry=np.full(8, NOW + 100),
+            created_at=np.full(8, NOW),
+        )
+        owners = {s // pool.local_capacity for s in slots}
+        assert owners == set(range(8))  # one slot per device first
+
+    def test_global_state_counts_psum(self, mesh):
+        pool = ShardedPool(4, 8, mesh)
+        pool.allocate_batch(
+            keys=[("s", i) for i in range(5)],
+            n=np.full(5, 3),
+            req=np.full(5, 2),
+            cap=np.full(5, 2),
+            gossip=np.ones(5, bool),
+            liveness=np.ones(5, bool),
+            expiry=np.full(5, NOW + 100),
+            created_at=np.full(5, NOW),
+        )
+        counts = pool.global_state_counts()
+        assert counts[STATE_ACTIVE] == 5
+        assert counts[STATE_FREE] == 32 - 5
+        # Device-side psum agrees with the host mirror.
+        assert counts == {**{k: 0 for k in counts}, **pool.state_counts()}
+
+
+class TestShardedEngine:
+    def test_quickstart_on_mesh(self, mesh):
+        engine = make_sharded_engine(mesh)
+        pid = engine.create_proposal("s", request(3), NOW).proposal_id
+        engine.cast_vote("s", pid, True, NOW)
+        v = build_vote(engine.get_proposal("s", pid), True, random_stub_signer(), NOW)
+        engine.process_incoming_vote("s", v, NOW)
+        assert engine.get_consensus_result("s", pid) is True
+
+    def test_cross_device_batch_ingest(self, mesh):
+        """One batch touching sessions on all 8 devices."""
+        engine = make_sharded_engine(mesh, per_device=4)
+        pids = [
+            engine.create_proposal(f"scope{i}", request(3, name=f"p{i}"), NOW).proposal_id
+            for i in range(8)
+        ]
+        items = []
+        for i, pid in enumerate(pids):
+            scope = f"scope{i}"
+            for _ in range(2):
+                vote = build_vote(
+                    engine.get_proposal(scope, pid), True, random_stub_signer(), NOW
+                )
+                # apply immediately to keep chains valid
+                st = engine.ingest_votes([(scope, vote)], NOW)
+                assert st[0] in (int(StatusCode.OK), int(StatusCode.ALREADY_REACHED))
+        for i, pid in enumerate(pids):
+            assert engine.get_consensus_result(f"scope{i}", pid) is True
+
+    def test_sharded_timeout_sweep(self, mesh):
+        engine = make_sharded_engine(mesh, per_device=4)
+        pids = [
+            engine.create_proposal("s", request(5, name=f"p{i}", exp=50), NOW + i).proposal_id
+            for i in range(8)
+        ]
+        for pid in pids[:4]:
+            engine.cast_vote("s", pid, True, NOW + 10)
+        swept = engine.sweep_timeouts(NOW + 100)
+        assert len(swept) == 8
+        # liveness=True fills every silent peer as YES at timeout, so all
+        # sessions (voted or not) decide YES — same as the scalar oracle.
+        assert all(result is True for _, _, result in swept)
+        assert {pid for _, pid, _ in swept} == set(pids)
+
+    @pytest.mark.parametrize("seed", range(2))
+    def test_random_trace_parity_on_mesh(self, seed, mesh):
+        """Randomized side-by-side trace: sharded engine vs scalar service."""
+        rng = np.random.default_rng(seed)
+        service = make_service()
+        engine = TpuConsensusEngine(
+            service.signer(),
+            pool=ShardedPool(8, 16, mesh),
+        )
+        service_rx = service.event_bus().subscribe()
+        engine_rx = engine.event_bus().subscribe()
+        voters = [random_stub_signer() for _ in range(8)]
+        scopes = ["alpha", "beta", "gamma"]
+        for scope in scopes:
+            if rng.random() < 0.5:
+                service.scope(scope).with_network_type(NetworkType.P2P).initialize()
+                engine.scope(scope).with_network_type(NetworkType.P2P).initialize()
+
+        pids: list[tuple[str, int]] = []
+        for step in range(50):
+            now = NOW + step
+            action = rng.random()
+            if action < 0.25 or not pids:
+                scope = scopes[int(rng.integers(len(scopes)))]
+                req_obj = CreateProposalRequest(
+                    name=f"p{step}",
+                    payload=b"x",
+                    proposal_owner=b"o",
+                    expected_voters_count=int(rng.integers(2, 8)),
+                    expiration_timestamp=int(rng.choice([30, 1000])),
+                    liveness_criteria_yes=bool(rng.random() < 0.5),
+                )
+                proposal = req_obj.into_proposal(now)
+                s_exc = e_exc = None
+                try:
+                    service.process_incoming_proposal(scope, proposal.clone(), now)
+                except ConsensusError as exc:
+                    s_exc = type(exc)
+                try:
+                    engine.process_incoming_proposal(scope, proposal.clone(), now)
+                except ConsensusError as exc:
+                    e_exc = type(exc)
+                assert s_exc == e_exc
+                if s_exc is None:
+                    pids.append((scope, proposal.proposal_id))
+            elif action < 0.85:
+                scope, pid = pids[int(rng.integers(len(pids)))]
+                signer = voters[int(rng.integers(len(voters)))]
+                choice = bool(rng.random() < 0.6)
+                s_exc = e_exc = None
+                vote = None
+                try:
+                    base = service.storage().get_proposal(scope, pid)
+                    vote = build_vote(base, choice, signer, now)
+                except ConsensusError as exc:
+                    s_exc = type(exc)
+                if vote is not None:
+                    try:
+                        service.process_incoming_vote(scope, vote.clone(), now)
+                    except ConsensusError as exc:
+                        s_exc = type(exc)
+                    try:
+                        engine.process_incoming_vote(scope, vote.clone(), now)
+                    except ConsensusError as exc:
+                        e_exc = type(exc)
+                    assert s_exc == e_exc, f"step {step}: {s_exc} vs {e_exc}"
+            else:
+                scope, pid = pids[int(rng.integers(len(pids)))]
+                s_res = e_res = s_exc = e_exc = None
+                try:
+                    s_res = service.handle_consensus_timeout(scope, pid, now)
+                except ConsensusError as exc:
+                    s_exc = type(exc)
+                try:
+                    e_res = engine.handle_consensus_timeout(scope, pid, now)
+                except ConsensusError as exc:
+                    e_exc = type(exc)
+                assert (s_res, s_exc) == (e_res, e_exc)
+
+        for scope, pid in pids:
+            s_session = service.storage().get_session(scope, pid)
+            if s_session is None:
+                with pytest.raises(SessionNotFound):
+                    engine.get_proposal(scope, pid)
+                continue
+            e_session = engine.export_session(scope, pid)
+            assert e_session.state == s_session.state, f"{scope}/{pid}"
+            assert set(e_session.votes) == set(s_session.votes)
+
+        # Event streams must match exactly.
+        def drain(rx):
+            out = []
+            while (item := rx.try_recv()) is not None:
+                out.append(item)
+            return out
+
+        assert drain(service_rx) == drain(engine_rx)

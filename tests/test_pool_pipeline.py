@@ -1,0 +1,73 @@
+"""Async ingest pipelining: ordering guarantees and mutation fencing."""
+
+import numpy as np
+import pytest
+
+from hashgraph_tpu.engine.pool import ProposalPool
+from hashgraph_tpu.ops import required_votes_np
+from hashgraph_tpu.errors import StatusCode
+
+NOW = 1_700_000_000
+
+
+def make_pool(p=8, v=8):
+    pool = ProposalPool(p, v)
+    pool.allocate_batch(
+        keys=[("s", i) for i in range(p)],
+        n=np.full(p, v),
+        req=required_votes_np(np.full(p, v), 1.0),
+        cap=np.full(p, 2),
+        gossip=np.ones(p, bool),
+        liveness=np.ones(p, bool),
+        expiry=np.full(p, NOW + 100),
+        created_at=np.full(p, NOW),
+    )
+    return pool
+
+
+def dispatch(pool, lane):
+    p = pool.capacity
+    return pool.ingest_async(
+        np.arange(p, dtype=np.int64),
+        np.full(p, lane, np.int32),
+        np.ones(p, bool),
+        NOW,
+    )
+
+
+class TestPipelineDiscipline:
+    def test_pipelined_dispatches_complete_in_order(self):
+        pool = make_pool()
+        pends = [dispatch(pool, lane) for lane in range(4)]
+        results = pool.complete_all(pends)
+        for statuses, _ in results:
+            assert all(s == int(StatusCode.OK) for s in statuses)
+        assert int(np.asarray(pool._tot)[0]) == 4
+
+    def test_out_of_order_completion_rejected(self):
+        pool = make_pool()
+        p1 = dispatch(pool, 0)
+        p2 = dispatch(pool, 1)
+        with pytest.raises(RuntimeError, match="dispatch order"):
+            pool.complete(p2)
+        pool.complete(p1)
+        pool.complete(p2)
+
+    def test_mutations_fenced_while_inflight(self):
+        pool = make_pool()
+        pending = dispatch(pool, 0)
+        with pytest.raises(RuntimeError, match="in flight"):
+            pool.timeout([0])
+        with pytest.raises(RuntimeError, match="in flight"):
+            pool.release([0])
+        with pytest.raises(RuntimeError, match="in flight"):
+            pool.load_rows(
+                [0],
+                np.array([1]),
+                np.array([0]),
+                np.array([0]),
+                np.zeros((1, pool.voter_capacity), bool),
+                np.zeros((1, pool.voter_capacity), bool),
+            )
+        pool.complete(pending)
+        pool.timeout([0])  # allowed again once drained
