@@ -17,9 +17,19 @@ from .decide import (
     state_result,
     timeout_update,
 )
+from .chain import (
+    chain_kernel,
+    chain_kernel_batch,
+    first_chain_error,
+    pack_chain,
+)
 from .ingest import ingest_kernel
 
 __all__ = [
+    "chain_kernel",
+    "chain_kernel_batch",
+    "first_chain_error",
+    "pack_chain",
     "STATE_FREE",
     "STATE_ACTIVE",
     "STATE_FAILED",

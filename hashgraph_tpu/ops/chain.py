@@ -1,0 +1,188 @@
+"""Vectorized hashgraph vote-chain validation.
+
+The vote chain is this framework's "long context": an append-only
+hash-linked sequence per proposal (reference: src/utils.rs:175-215). The
+scalar rules only reference index ``i-1`` (received link) and one
+hash-indexed earlier vote (parent link), so validation needs no sequential
+scan — it becomes a shifted row-compare plus an O(V²) equality matrix, both
+embarrassingly parallel and vmappable over a proposal batch (SURVEY §5
+long-context row).
+
+Exact reference semantics reproduced:
+- received rule (``idx > 0`` only — index 0 is never checked): a non-empty
+  ``received_hash`` must equal the previous vote's ``vote_hash`` and the
+  previous timestamp must be ≤ this one's (utils.rs:188-198);
+- parent rule: a non-empty ``parent_hash`` is looked up in a hash→index map
+  built with LAST-occurrence-wins over the full list (utils.rs:181-184);
+  that single entry must be an earlier index, same owner, timestamp ≤
+  (utils.rs:200-211) — existence of *some* matching earlier vote is NOT
+  sufficient if a later vote shadows it in the map;
+- fail-fast order: first offending index wins; within one index the
+  received check precedes the parent check.
+
+Device encoding (host packs via :func:`pack_chain`):
+- hashes → ``int32[V, 9]``: 8 little-endian 4-byte words + a length column
+  (length participates in equality; hashes over 32 bytes are canonicalised
+  through SHA-256 first, preserving equality with cryptographic certainty);
+- u64 timestamps → two bias-encoded int32 columns (hi, lo) compared
+  lexicographically (TPU kernels run without x64);
+- owners → dict-encoded int32 ids (exact bytes equality, no hash collisions).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..errors import StatusCode
+from ..wire import Vote
+
+__all__ = [
+    "pack_chain",
+    "chain_body",
+    "chain_kernel",
+    "chain_kernel_batch",
+    "first_chain_error",
+]
+
+HASH_WORDS = 8
+_BIAS = np.int64(-0x80000000)  # maps u32 order onto i32 order
+
+_OK = int(StatusCode.OK)
+_RECV = int(StatusCode.RECEIVED_HASH_MISMATCH)
+_PARENT = int(StatusCode.PARENT_HASH_MISMATCH)
+
+
+def _pack_hashes(hashes: list[bytes]) -> np.ndarray:
+    """[V] bytes -> int32[V, 9] (8 words + length; empty = all-zero row)."""
+    v = len(hashes)
+    out = np.zeros((v, HASH_WORDS + 1), np.int32)
+    for i, h in enumerate(hashes):
+        if len(h) > 32:
+            h = hashlib.sha256(h).digest()
+            length = 33  # sentinel: "canonicalised long hash"
+        else:
+            length = len(h)
+        padded = h + b"\x00" * (32 - len(h))
+        out[i, :HASH_WORDS] = np.frombuffer(padded, np.uint32).view(np.int32)
+        out[i, HASH_WORDS] = length
+    return out
+
+
+def _pack_ts(ts: list[int]) -> np.ndarray:
+    """u64 timestamps -> bias-encoded int32[V, 2] (hi, lo), order-preserving
+    under lexicographic signed comparison."""
+    arr = np.array(ts, np.uint64)
+    hi = ((arr >> np.uint64(32)).astype(np.int64) + _BIAS).astype(np.int32)
+    lo = ((arr & np.uint64(0xFFFFFFFF)).astype(np.int64) + _BIAS).astype(np.int32)
+    return np.stack([hi, lo], axis=1)
+
+
+def pack_chain(
+    votes: list[Vote], pad_to: int | None = None
+) -> dict[str, np.ndarray]:
+    """Encode a proposal's ordered vote list for the device kernel."""
+    v = len(votes)
+    width = pad_to if pad_to is not None else v
+    if width < v:
+        raise ValueError("pad_to smaller than vote count")
+
+    owners: dict[bytes, int] = {}
+    owner_ids = np.zeros(width, np.int32)
+    for i, vote in enumerate(votes):
+        owner_ids[i] = owners.setdefault(vote.vote_owner, len(owners))
+
+    def field(hashes: list[bytes]) -> np.ndarray:
+        packed = _pack_hashes(hashes)
+        out = np.zeros((width, HASH_WORDS + 1), np.int32)
+        out[:v] = packed
+        return out
+
+    ts = np.zeros((width, 2), np.int32)
+    ts[:v] = _pack_ts([vote.timestamp for vote in votes])
+    valid = np.zeros(width, bool)
+    valid[:v] = True
+    return dict(
+        vote_hash=field([vote.vote_hash for vote in votes]),
+        received_hash=field([vote.received_hash for vote in votes]),
+        parent_hash=field([vote.parent_hash for vote in votes]),
+        owner=owner_ids,
+        ts=ts,
+        valid=valid,
+    )
+
+
+def _ts_le(a, b):
+    """Lexicographic ≤ over bias-encoded (hi, lo) int32 pairs."""
+    return (a[..., 0] < b[..., 0]) | (
+        (a[..., 0] == b[..., 0]) & (a[..., 1] <= b[..., 1])
+    )
+
+
+def chain_body(vote_hash, received_hash, parent_hash, owner, ts, valid):
+    """Per-vote chain statuses for one proposal's ordered votes.
+
+    Args (device arrays, V = padded vote count):
+      vote_hash / received_hash / parent_hash: int32[V, 9]
+      owner: int32[V] dict-encoded owner ids
+      ts: int32[V, 2] bias-encoded timestamps
+      valid: bool[V] real-vote mask (pad rows always pass)
+
+    Returns int32[V]: OK / RECEIVED_HASH_MISMATCH / PARENT_HASH_MISMATCH per
+    vote, with the reference's intra-vote precedence (received first).
+    """
+    v = vote_hash.shape[0]
+    idx = jnp.arange(v)
+    empty_recv = received_hash[:, HASH_WORDS] == 0
+    empty_parent = parent_hash[:, HASH_WORDS] == 0
+
+    # Received rule: row i vs row i-1 (row 0 exempt).
+    prev_hash = jnp.roll(vote_hash, 1, axis=0)
+    prev_ts = jnp.roll(ts, 1, axis=0)
+    recv_eq = jnp.all(received_hash == prev_hash, axis=1)
+    recv_ok = (
+        (idx == 0)
+        | empty_recv
+        | (recv_eq & _ts_le(prev_ts, ts))
+    )
+
+    # Parent rule: last-occurrence hash index. eq[i, j] = parent i matches
+    # vote-hash j (pad rows excluded); j* = max matching j.
+    eq = jnp.all(
+        parent_hash[:, None, :] == vote_hash[None, :, :], axis=2
+    ) & valid[None, :]
+    j_star = jnp.max(jnp.where(eq, idx[None, :], -1), axis=1)
+    found = j_star >= 0
+    j_clip = jnp.maximum(j_star, 0)
+    parent_ok = empty_parent | (
+        found
+        & (jnp.take(owner, j_clip) == owner)
+        & _ts_le(jnp.take(ts, j_clip, axis=0), ts)
+        & (j_star < idx)
+    )
+
+    status = jnp.where(
+        ~recv_ok,
+        _RECV,
+        jnp.where(~parent_ok, _PARENT, _OK),
+    ).astype(jnp.int32)
+    return jnp.where(valid, status, _OK)
+
+
+chain_kernel = jax.jit(chain_body)
+# Batched over a [B, V, ...] proposal axis — config-5-style bulk replay.
+chain_kernel_batch = jax.jit(jax.vmap(chain_body))
+
+
+def first_chain_error(statuses: np.ndarray) -> int:
+    """Reduce per-vote statuses to the reference's fail-fast result: the
+    status of the first offending vote, or OK. Lists of length ≤ 1 are
+    trivially valid (utils.rs:176-178) — callers skip the kernel for those.
+    """
+    statuses = np.asarray(statuses)
+    bad = np.nonzero(statuses != _OK)[0]
+    return int(statuses[bad[0]]) if bad.size else _OK
