@@ -1,0 +1,190 @@
+"""ctypes bindings for the native C++ host runtime (hashing + ECDSA).
+
+Loads ``native/build/libconsensus_native.so``, building it on first use when
+a compiler is available (the library is ~1s to compile and has zero
+dependencies). Every entry point has a pure-Python fallback elsewhere in the
+package, so the framework works without it — the native path exists for host
+throughput: EIP-191 verification is ~20-40x faster per core than the
+pure-Python curve math, and the batch calls release the GIL and fan out over
+hardware threads.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEFAULT_SO = os.path.join(_REPO_ROOT, "native", "build", "libconsensus_native.so")
+_SOURCE = os.path.join(_REPO_ROOT, "native", "consensus_native.cpp")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    try:
+        os.makedirs(os.path.dirname(_DEFAULT_SO), exist_ok=True)
+        subprocess.run(
+            [
+                "g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
+                "-o", _DEFAULT_SO, _SOURCE,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_attempted
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        path = os.environ.get("HASHGRAPH_TPU_NATIVE", _DEFAULT_SO)
+        if not os.path.exists(path):
+            # Only auto-build the default artifact; an explicit env override
+            # pointing at a missing file is the caller's mistake to surface.
+            if path != _DEFAULT_SO or not os.path.exists(_SOURCE) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.hg_version.restype = ctypes.c_int
+        lib.hg_sha256.argtypes = [u8p, ctypes.c_uint64, u8p]
+        lib.hg_keccak256.argtypes = [u8p, ctypes.c_uint64, u8p]
+        for fn in (lib.hg_sha256_batch, lib.hg_keccak256_batch):
+            fn.argtypes = [u8p, u64p, ctypes.c_int64, u8p, ctypes.c_int]
+        lib.hg_eth_verify.restype = ctypes.c_int
+        lib.hg_eth_verify.argtypes = [u8p, u8p, ctypes.c_uint64, u8p]
+        lib.hg_eth_verify_batch.argtypes = [
+            u8p, u8p, u64p, u8p, ctypes.c_int64, u8p, ctypes.c_int,
+        ]
+        lib.hg_eth_sign.restype = ctypes.c_int
+        lib.hg_eth_sign.argtypes = [u8p, u8p, ctypes.c_uint64, u8p]
+        lib.hg_eth_address.restype = ctypes.c_int
+        lib.hg_eth_address.argtypes = [u8p, u8p]
+        if lib.hg_version() != 1:
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _u8(buf) -> ctypes.POINTER(ctypes.c_uint8):
+    return ctypes.cast(
+        (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf), ctypes.POINTER(ctypes.c_uint8)
+    )
+
+
+def _np_u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def keccak256(data: bytes) -> bytes | None:
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty(32, np.uint8)
+    lib.hg_keccak256(_u8(data), len(data), _np_u8p(out))
+    return out.tobytes()
+
+
+def sha256_batch(items: list[bytes], n_threads: int = 0) -> np.ndarray | None:
+    """[K] digests as uint8[K, 32], or None when the runtime is absent."""
+    return _hash_batch(items, n_threads, "hg_sha256_batch")
+
+
+def keccak256_batch(items: list[bytes], n_threads: int = 0) -> np.ndarray | None:
+    return _hash_batch(items, n_threads, "hg_keccak256_batch")
+
+
+def _hash_batch(items: list[bytes], n_threads: int, fn_name: str) -> np.ndarray | None:
+    lib = _load()
+    if lib is None:
+        return None
+    data = np.frombuffer(b"".join(items) or b"\x00", np.uint8).copy()
+    offsets = np.zeros(len(items) + 1, np.uint64)
+    np.cumsum([len(b) for b in items], out=offsets[1:])
+    out = np.empty((len(items), 32), np.uint8)
+    getattr(lib, fn_name)(
+        _np_u8p(data),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(items),
+        _np_u8p(out),
+        n_threads,
+    )
+    return out
+
+
+def eth_verify(identity: bytes, payload: bytes, signature: bytes) -> int | None:
+    """1 valid, 0 address mismatch, -1 malformed recovery byte, -2 recovery
+    failed; None if the native runtime is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    return lib.hg_eth_verify(_u8(identity), _u8(payload), len(payload), _u8(signature))
+
+
+def eth_verify_batch(
+    identities: list[bytes],
+    payloads: list[bytes],
+    signatures: list[bytes],
+    n_threads: int = 0,
+) -> np.ndarray | None:
+    """uint8[K]: 1 valid, 0 address mismatch, 255 malformed recovery byte,
+    254 recovery failed; None if unavailable. Caller guarantees 20-byte
+    identities and 65-byte signatures."""
+    lib = _load()
+    if lib is None:
+        return None
+    k = len(identities)
+    ids = np.frombuffer(b"".join(identities) or b"\x00", np.uint8).copy()
+    sigs = np.frombuffer(b"".join(signatures) or b"\x00", np.uint8).copy()
+    data = np.frombuffer(b"".join(payloads) or b"\x00", np.uint8).copy()
+    offsets = np.zeros(k + 1, np.uint64)
+    np.cumsum([len(b) for b in payloads], out=offsets[1:])
+    out = np.empty(k, np.uint8)
+    lib.hg_eth_verify_batch(
+        _np_u8p(ids),
+        _np_u8p(data),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        _np_u8p(sigs),
+        k,
+        _np_u8p(out),
+        n_threads,
+    )
+    return out
+
+
+def eth_sign(private_key: bytes, payload: bytes) -> bytes | None:
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty(65, np.uint8)
+    rc = lib.hg_eth_sign(_u8(private_key), _u8(payload), len(payload), _np_u8p(out))
+    return out.tobytes() if rc == 0 else None
+
+
+def eth_address(private_key: bytes) -> bytes | None:
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty(20, np.uint8)
+    rc = lib.hg_eth_address(_u8(private_key), _np_u8p(out))
+    return out.tobytes() if rc == 0 else None

@@ -46,6 +46,25 @@ class ConsensusSignatureScheme(abc.ABC):
         :class:`ConsensusSchemeError` for malformed ones (wrong lengths etc.).
         """
 
+    @classmethod
+    def verify_batch(
+        cls,
+        identities: list[bytes],
+        payloads: list[bytes],
+        signatures: list[bytes],
+    ) -> list[bool | ConsensusSchemeError]:
+        """Bulk verification for the ingest pipeline: one entry per item,
+        either the boolean verdict or the scheme error that ``verify`` would
+        have raised. Default is a scalar loop; schemes with a native batched
+        path (Ethereum) override this."""
+        out: list[bool | ConsensusSchemeError] = []
+        for identity, payload, signature in zip(identities, payloads, signatures):
+            try:
+                out.append(cls.verify(identity, payload, signature))
+            except ConsensusSchemeError as exc:
+                out.append(exc)
+        return out
+
 
 from .ethereum import EthereumConsensusSigner  # noqa: E402
 from .stub import StubConsensusSigner  # noqa: E402

@@ -13,6 +13,7 @@ from __future__ import annotations
 import secrets
 
 from ..errors import ConsensusSchemeError
+from .. import native
 from . import ConsensusSignatureScheme
 from ._keccak import keccak256
 from ._secp256k1 import N, pubkey_from_private, recover_pubkey, sign_recoverable
@@ -67,6 +68,9 @@ class EthereumConsensusSigner(ConsensusSignatureScheme):
         return self._private_key.to_bytes(32, "big")
 
     def sign(self, payload: bytes) -> bytes:
+        signature = native.eth_sign(self.private_key_bytes(), payload)
+        if signature is not None:
+            return signature
         try:
             r, s, v = sign_recoverable(eip191_hash(payload), self._private_key)
         except Exception as exc:  # pragma: no cover - curve math never fails in practice
@@ -94,7 +98,72 @@ class EthereumConsensusSigner(ConsensusSignatureScheme):
         if v > 1:
             raise ConsensusSchemeError.verify(f"invalid recovery id byte: {signature[64]}")
 
+        verdict = native.eth_verify(bytes(identity), payload, signature)
+        if verdict is not None:
+            if verdict == -2:
+                raise ConsensusSchemeError.verify("signature recovery failed")
+            return verdict == 1
+
         pubkey = recover_pubkey(eip191_hash(payload), r, s, v)
         if pubkey is None:
             raise ConsensusSchemeError.verify("signature recovery failed")
         return address_from_pubkey(pubkey) == bytes(identity)
+
+    @classmethod
+    def verify_batch(
+        cls,
+        identities: list[bytes],
+        payloads: list[bytes],
+        signatures: list[bytes],
+    ) -> list[bool | ConsensusSchemeError]:
+        """Native threaded batch verification (GIL released for the whole
+        batch); falls back to the scalar loop without the native runtime."""
+        well_formed: list[int] = []
+        out: list[bool | ConsensusSchemeError] = []
+        # zip() truncation keeps the base-class contract for ragged inputs.
+        for i, (identity, _payload, signature) in enumerate(
+            zip(identities, payloads, signatures)
+        ):
+            if len(signature) != ETHEREUM_SIGNATURE_LENGTH:
+                out.append(
+                    ConsensusSchemeError.verify(
+                        f"expected {ETHEREUM_SIGNATURE_LENGTH}-byte signature, "
+                        f"got {len(signature)}"
+                    )
+                )
+            elif len(identity) != ETHEREUM_ADDRESS_LENGTH:
+                out.append(
+                    ConsensusSchemeError.verify(
+                        f"expected {ETHEREUM_ADDRESS_LENGTH}-byte address, "
+                        f"got {len(identity)}"
+                    )
+                )
+            else:
+                out.append(False)  # placeholder
+                well_formed.append(i)
+        if not well_formed:
+            return out
+        results = native.eth_verify_batch(
+            [bytes(identities[i]) for i in well_formed],
+            [payloads[i] for i in well_formed],
+            [signatures[i] for i in well_formed],
+        )
+        if results is None:
+            for i in well_formed:
+                try:
+                    out[i] = cls.verify(identities[i], payloads[i], signatures[i])
+                except ConsensusSchemeError as exc:
+                    out[i] = exc
+            return out
+        for i, code in zip(well_formed, results):
+            if code == 1:
+                out[i] = True
+            elif code == 0:
+                out[i] = False
+            elif code == 254:
+                out[i] = ConsensusSchemeError.verify("signature recovery failed")
+            else:
+                out[i] = ConsensusSchemeError.verify(
+                    f"invalid recovery id byte: {signatures[i][64]}"
+                )
+        return out

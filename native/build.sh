@@ -1,0 +1,10 @@
+#!/bin/sh
+# Build the native host runtime into native/build/libconsensus_native.so.
+# The Python wrapper (hashgraph_tpu/native.py) also invokes this lazily when
+# the shared object is missing and a compiler is available.
+set -e
+cd "$(dirname "$0")"
+mkdir -p build
+g++ -O3 -fPIC -shared -std=c++17 -pthread \
+    -o build/libconsensus_native.so consensus_native.cpp
+echo "built build/libconsensus_native.so"

@@ -1,0 +1,730 @@
+// Native host runtime for hashgraph_tpu: batched hashing + secp256k1 ECDSA.
+//
+// The TPU owns tallies and decisions; the host owns crypto (the reference
+// delegates it to alloy's signer stack, src/signing/ethereum.rs:58-97 — here
+// it is a from-scratch C++ implementation, no third-party code). Exposed as
+// a C ABI consumed via ctypes (hashgraph_tpu/native.py); every batch entry
+// point releases the GIL by construction and fans out over std::thread.
+//
+// Implemented primitives:
+//   - SHA-256 (FIPS 180-4) + HMAC-SHA256 (RFC 6979 nonces)
+//   - Keccak-256 (pre-NIST padding, Ethereum flavor)
+//   - secp256k1 field/scalar arithmetic (4x64 limbs, 2^256-c folding),
+//     Jacobian point ops, fixed-base window table for G
+//   - ECDSA sign (RFC 6979, low-s) and public-key recovery
+//   - EIP-191 verify: prefix-hash -> recover -> keccak address -> compare
+//
+// Build: native/build.sh (g++ -O3 -shared). The Python wrapper falls back to
+// the pure-Python implementations when the shared object is absent.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+// ───────────────────────────── SHA-256 ─────────────────────────────
+
+static const uint32_t SHA_K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static inline uint32_t rotr32(uint32_t x, int n) {
+  return (x >> n) | (x << (32 - n));
+}
+
+static void sha256_compress(uint32_t h[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = (uint32_t(block[4 * i]) << 24) | (uint32_t(block[4 * i + 1]) << 16) |
+           (uint32_t(block[4 * i + 2]) << 8) | uint32_t(block[4 * i + 3]);
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr32(w[i - 15], 7) ^ rotr32(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr32(w[i - 2], 17) ^ rotr32(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+           g = h[6], hh = h[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = hh + S1 + ch + SHA_K[i] + w[i];
+    uint32_t S0 = rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + maj;
+    hh = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+static void sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  size_t off = 0;
+  for (; off + 64 <= len; off += 64) sha256_compress(h, data + off);
+  uint8_t block[128] = {0};
+  size_t tail = len - off;
+  memcpy(block, data + off, tail);
+  block[tail] = 0x80;
+  size_t blocks = (tail + 9 <= 64) ? 1 : 2;
+  uint64_t bits = uint64_t(len) * 8;
+  for (int i = 0; i < 8; i++)
+    block[blocks * 64 - 1 - i] = uint8_t(bits >> (8 * i));
+  for (size_t b = 0; b < blocks; b++) sha256_compress(h, block + 64 * b);
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = uint8_t(h[i] >> 24);
+    out[4 * i + 1] = uint8_t(h[i] >> 16);
+    out[4 * i + 2] = uint8_t(h[i] >> 8);
+    out[4 * i + 3] = uint8_t(h[i]);
+  }
+}
+
+static void hmac_sha256(const uint8_t* key, size_t keylen, const uint8_t* m1,
+                        size_t l1, const uint8_t* m2, size_t l2,
+                        const uint8_t* m3, size_t l3, const uint8_t* m4,
+                        size_t l4, uint8_t out[32]) {
+  uint8_t k[64] = {0};
+  if (keylen > 64) {
+    sha256(key, keylen, k);
+  } else {
+    memcpy(k, key, keylen);
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; i++) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  // inner = sha256(ipad || m1 || m2 || m3 || m4)
+  std::vector<uint8_t> buf;
+  buf.reserve(64 + l1 + l2 + l3 + l4);
+  buf.insert(buf.end(), ipad, ipad + 64);
+  buf.insert(buf.end(), m1, m1 + l1);
+  buf.insert(buf.end(), m2, m2 + l2);
+  buf.insert(buf.end(), m3, m3 + l3);
+  buf.insert(buf.end(), m4, m4 + l4);
+  uint8_t inner[32];
+  sha256(buf.data(), buf.size(), inner);
+  uint8_t outer[96];
+  memcpy(outer, opad, 64);
+  memcpy(outer + 64, inner, 32);
+  sha256(outer, 96, out);
+}
+
+// ──────────────────────────── Keccak-256 ───────────────────────────
+
+static const uint64_t KECCAK_RC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
+    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
+    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+
+static const int KECCAK_ROT[25] = {0,  1,  62, 28, 27, 36, 44, 6,  55,
+                                   20, 3,  10, 43, 25, 39, 41, 45, 15,
+                                   21, 8,  18, 2,  61, 56, 14};
+
+static inline uint64_t rotl64(uint64_t x, int n) {
+  return n == 0 ? x : (x << n) | (x >> (64 - n));
+}
+
+static void keccak_f1600(uint64_t A[25]) {
+  for (int round = 0; round < 24; round++) {
+    uint64_t C[5], D[5], B[25];
+    for (int x = 0; x < 5; x++)
+      C[x] = A[x] ^ A[x + 5] ^ A[x + 10] ^ A[x + 15] ^ A[x + 20];
+    for (int x = 0; x < 5; x++)
+      D[x] = C[(x + 4) % 5] ^ rotl64(C[(x + 1) % 5], 1);
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 25; y += 5) A[x + y] ^= D[x];
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 5; y++)
+        B[y + 5 * ((2 * x + 3 * y) % 5)] = rotl64(A[x + 5 * y], KECCAK_ROT[x + 5 * y]);
+    for (int y = 0; y < 25; y += 5)
+      for (int x = 0; x < 5; x++)
+        A[x + y] = B[x + y] ^ ((~B[(x + 1) % 5 + y]) & B[(x + 2) % 5 + y]);
+    A[0] ^= KECCAK_RC[round];
+  }
+}
+
+static void keccak256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  const size_t rate = 136;
+  uint64_t A[25] = {0};
+  size_t off = 0;
+  while (len - off >= rate) {
+    for (size_t i = 0; i < rate / 8; i++) {
+      uint64_t lane;
+      memcpy(&lane, data + off + 8 * i, 8);
+      A[i] ^= lane;  // little-endian host assumed (x86/arm64)
+    }
+    keccak_f1600(A);
+    off += rate;
+  }
+  uint8_t block[136] = {0};
+  memcpy(block, data + off, len - off);
+  block[len - off] ^= 0x01;
+  block[rate - 1] ^= 0x80;
+  for (size_t i = 0; i < rate / 8; i++) {
+    uint64_t lane;
+    memcpy(&lane, block + 8 * i, 8);
+    A[i] ^= lane;
+  }
+  keccak_f1600(A);
+  memcpy(out, A, 32);
+}
+
+// ───────────────────── 256-bit modular arithmetic ──────────────────
+// Little-endian 4x64 limbs. Moduli are 2^256 - c with small-ish c, so
+// reduction is repeated folding: hi * c + lo.
+
+struct U256 {
+  uint64_t v[4];
+};
+
+static inline bool u256_is_zero(const U256& a) {
+  return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+static inline int u256_cmp(const U256& a, const U256& b) {
+  for (int i = 3; i >= 0; i--) {
+    if (a.v[i] < b.v[i]) return -1;
+    if (a.v[i] > b.v[i]) return 1;
+  }
+  return 0;
+}
+
+static inline uint64_t u256_add(U256& r, const U256& a, const U256& b) {
+  unsigned __int128 carry = 0;
+  for (int i = 0; i < 4; i++) {
+    carry += (unsigned __int128)a.v[i] + b.v[i];
+    r.v[i] = (uint64_t)carry;
+    carry >>= 64;
+  }
+  return (uint64_t)carry;
+}
+
+static inline uint64_t u256_sub(U256& r, const U256& a, const U256& b) {
+  unsigned __int128 borrow = 0;
+  for (int i = 0; i < 4; i++) {
+    unsigned __int128 d = (unsigned __int128)a.v[i] - b.v[i] - borrow;
+    r.v[i] = (uint64_t)d;
+    borrow = (d >> 64) & 1;
+  }
+  return (uint64_t)borrow;
+}
+
+// out[0..7] = a * b
+static void u256_mul_full(const U256& a, const U256& b, uint64_t out[8]) {
+  memset(out, 0, 8 * sizeof(uint64_t));
+  for (int i = 0; i < 4; i++) {
+    unsigned __int128 carry = 0;
+    for (int j = 0; j < 4; j++) {
+      carry += (unsigned __int128)a.v[i] * b.v[j] + out[i + j];
+      out[i + j] = (uint64_t)carry;
+      carry >>= 64;
+    }
+    out[i + 4] = (uint64_t)carry;
+  }
+}
+
+struct Modulus {
+  U256 m;  // 2^256 - c
+  U256 c;  // the folding constant (fits in <= 3 limbs)
+};
+
+// Reduce an 8-limb value modulo m = 2^256 - c by folding hi*c into lo.
+static U256 mod_reduce512(const uint64_t t_in[8], const Modulus& mod) {
+  uint64_t t[12];
+  memcpy(t, t_in, 8 * sizeof(uint64_t));
+  memset(t + 8, 0, 4 * sizeof(uint64_t));
+  // Fold until limbs above 3 are clear (terminates: c < 2^130).
+  for (int iter = 0; iter < 4; iter++) {
+    bool high = false;
+    for (int i = 4; i < 12; i++) high |= (t[i] != 0);
+    if (!high) break;
+    uint64_t hi[8];
+    memcpy(hi, t + 4, 8 * sizeof(uint64_t));
+    memset(t + 4, 0, 8 * sizeof(uint64_t));
+    // t += hi * c   (hi up to 8 limbs but after first fold it is small)
+    for (int i = 0; i < 8; i++) {
+      if (hi[i] == 0) continue;
+      unsigned __int128 carry = 0;
+      for (int j = 0; j < 3; j++) {
+        if (i + j >= 12) break;
+        carry += (unsigned __int128)hi[i] * mod.c.v[j] + t[i + j];
+        t[i + j] = (uint64_t)carry;
+        carry >>= 64;
+      }
+      for (int k = i + 3; carry && k < 12; k++) {
+        carry += t[k];
+        t[k] = (uint64_t)carry;
+        carry >>= 64;
+      }
+    }
+  }
+  U256 r = {{t[0], t[1], t[2], t[3]}};
+  while (u256_cmp(r, mod.m) >= 0) u256_sub(r, r, mod.m);
+  return r;
+}
+
+static U256 mod_mul(const U256& a, const U256& b, const Modulus& mod) {
+  uint64_t t[8];
+  u256_mul_full(a, b, t);
+  return mod_reduce512(t, mod);
+}
+
+static U256 mod_add(const U256& a, const U256& b, const Modulus& mod) {
+  U256 r;
+  uint64_t carry = u256_add(r, a, b);
+  if (carry) {
+    // r + 2^256 ≡ r + c (mod m)
+    U256 r2;
+    uint64_t c2 = u256_add(r2, r, mod.c);
+    r = r2;
+    if (c2) u256_add(r, r, mod.c);  // cannot carry twice for our c
+  }
+  while (u256_cmp(r, mod.m) >= 0) u256_sub(r, r, mod.m);
+  return r;
+}
+
+static U256 mod_sub(const U256& a, const U256& b, const Modulus& mod) {
+  U256 r;
+  if (u256_sub(r, a, b)) u256_add(r, r, mod.m);
+  return r;
+}
+
+static U256 mod_pow(const U256& base, const U256& exp, const Modulus& mod) {
+  U256 result = {{1, 0, 0, 0}};
+  U256 acc = base;
+  for (int limb = 0; limb < 4; limb++) {
+    uint64_t e = exp.v[limb];
+    for (int bit = 0; bit < 64; bit++) {
+      if (e & 1) result = mod_mul(result, acc, mod);
+      acc = mod_mul(acc, acc, mod);
+      e >>= 1;
+    }
+  }
+  return result;
+}
+
+static U256 u256_from_be(const uint8_t b[32]) {
+  U256 r;
+  for (int i = 0; i < 4; i++) {
+    uint64_t limb = 0;
+    for (int j = 0; j < 8; j++) limb = (limb << 8) | b[(3 - i) * 8 + j];
+    r.v[i] = limb;
+  }
+  return r;
+}
+
+static void u256_to_be(const U256& a, uint8_t out[32]) {
+  for (int i = 0; i < 4; i++) {
+    uint64_t limb = a.v[3 - i];
+    for (int j = 0; j < 8; j++) out[i * 8 + j] = uint8_t(limb >> (8 * (7 - j)));
+  }
+}
+
+// secp256k1 constants.
+static const Modulus FP = {
+    {{0xFFFFFFFEFFFFFC2FULL, 0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFFFFFFFFFFULL,
+      0xFFFFFFFFFFFFFFFFULL}},
+    {{0x00000001000003D1ULL, 0, 0, 0}}};
+static const Modulus FN = {
+    {{0xBFD25E8CD0364141ULL, 0xBAAEDCE6AF48A03BULL, 0xFFFFFFFFFFFFFFFEULL,
+      0xFFFFFFFFFFFFFFFFULL}},
+    {{0x402DA1732FC9BEBFULL, 0x4551231950B75FC4ULL, 0x1ULL, 0}}};
+static const U256 GX = {{0x59F2815B16F81798ULL, 0x029BFCDB2DCE28D9ULL,
+                         0x55A06295CE870B07ULL, 0x79BE667EF9DCBBACULL}};
+static const U256 GY = {{0x9C47D08FFB10D4B8ULL, 0xFD17B448A6855419ULL,
+                         0x5DA4FBFC0E1108A8ULL, 0x483ADA7726A3C465ULL}};
+
+static U256 fp_inv(const U256& a) {
+  U256 e = FP.m;
+  U256 two = {{2, 0, 0, 0}};
+  u256_sub(e, e, two);
+  return mod_pow(a, e, FP);
+}
+
+static U256 fn_inv(const U256& a) {
+  U256 e = FN.m;
+  U256 two = {{2, 0, 0, 0}};
+  u256_sub(e, e, two);
+  return mod_pow(a, e, FN);
+}
+
+// ─────────────────── Jacobian point arithmetic (mod p) ─────────────
+
+struct Point {
+  U256 x, y, z;  // z == 0 encodes infinity
+};
+
+static const Point P_INF = {{{0, 0, 0, 0}}, {{1, 0, 0, 0}}, {{0, 0, 0, 0}}};
+
+static inline bool pt_is_inf(const Point& p) { return u256_is_zero(p.z); }
+
+static Point pt_double(const Point& p) {
+  if (pt_is_inf(p) || u256_is_zero(p.y)) return P_INF;
+  U256 a = mod_mul(p.x, p.x, FP);
+  U256 b = mod_mul(p.y, p.y, FP);
+  U256 c = mod_mul(b, b, FP);
+  U256 xb = mod_add(p.x, b, FP);
+  U256 d = mod_sub(mod_sub(mod_mul(xb, xb, FP), a, FP), c, FP);
+  d = mod_add(d, d, FP);
+  U256 e = mod_add(mod_add(a, a, FP), a, FP);
+  U256 f = mod_mul(e, e, FP);
+  U256 x3 = mod_sub(f, mod_add(d, d, FP), FP);
+  U256 c8 = mod_add(c, c, FP);
+  c8 = mod_add(c8, c8, FP);
+  c8 = mod_add(c8, c8, FP);
+  U256 y3 = mod_sub(mod_mul(e, mod_sub(d, x3, FP), FP), c8, FP);
+  U256 z3 = mod_mul(p.y, p.z, FP);
+  z3 = mod_add(z3, z3, FP);
+  return {x3, y3, z3};
+}
+
+static Point pt_add(const Point& p1, const Point& p2) {
+  if (pt_is_inf(p1)) return p2;
+  if (pt_is_inf(p2)) return p1;
+  U256 z1z1 = mod_mul(p1.z, p1.z, FP);
+  U256 z2z2 = mod_mul(p2.z, p2.z, FP);
+  U256 u1 = mod_mul(p1.x, z2z2, FP);
+  U256 u2 = mod_mul(p2.x, z1z1, FP);
+  U256 s1 = mod_mul(mod_mul(p1.y, p2.z, FP), z2z2, FP);
+  U256 s2 = mod_mul(mod_mul(p2.y, p1.z, FP), z1z1, FP);
+  if (u256_cmp(u1, u2) == 0) {
+    if (u256_cmp(s1, s2) != 0) return P_INF;
+    return pt_double(p1);
+  }
+  U256 h = mod_sub(u2, u1, FP);
+  U256 h2 = mod_add(h, h, FP);
+  U256 i = mod_mul(h2, h2, FP);
+  U256 j = mod_mul(h, i, FP);
+  U256 r = mod_sub(s2, s1, FP);
+  r = mod_add(r, r, FP);
+  U256 v = mod_mul(u1, i, FP);
+  U256 x3 = mod_sub(mod_sub(mod_mul(r, r, FP), j, FP), mod_add(v, v, FP), FP);
+  U256 s1j = mod_mul(s1, j, FP);
+  U256 y3 = mod_sub(mod_mul(r, mod_sub(v, x3, FP), FP), mod_add(s1j, s1j, FP), FP);
+  U256 z3 = mod_mul(mod_mul(h, p1.z, FP), p2.z, FP);
+  z3 = mod_add(z3, z3, FP);
+  return {x3, y3, z3};
+}
+
+static Point pt_mul(const Point& p, const U256& scalar) {
+  Point result = P_INF;
+  Point addend = p;
+  for (int limb = 0; limb < 4; limb++) {
+    uint64_t s = scalar.v[limb];
+    for (int bit = 0; bit < 64; bit++) {
+      if (s & 1) result = pt_add(result, addend);
+      addend = pt_double(addend);
+      s >>= 1;
+    }
+  }
+  return result;
+}
+
+// Fixed-base 4-bit window table for G: g_table[w][d-1] = (16^w * d) * G.
+// Callers enter through ctypes with the GIL released, so initialisation must
+// be race-free: std::call_once.
+static Point g_table[64][15];
+static std::once_flag g_table_once;
+
+static void build_g_table_impl() {
+  Point base = {GX, GY, {{1, 0, 0, 0}}};
+  for (int w = 0; w < 64; w++) {
+    Point acc = P_INF;
+    for (int d = 0; d < 15; d++) {
+      acc = pt_add(acc, base);
+      g_table[w][d] = acc;
+    }
+    for (int b = 0; b < 4; b++) base = pt_double(base);
+  }
+}
+
+static void build_g_table() { std::call_once(g_table_once, build_g_table_impl); }
+
+static Point g_mul(const U256& scalar) {
+  build_g_table();
+  Point result = P_INF;
+  for (int w = 0; w < 64; w++) {
+    int digit = (scalar.v[w / 16] >> (4 * (w % 16))) & 0xF;
+    if (digit) result = pt_add(result, g_table[w][digit - 1]);
+  }
+  return result;
+}
+
+static bool pt_to_affine(const Point& p, U256& x, U256& y) {
+  if (pt_is_inf(p)) return false;
+  U256 zi = fp_inv(p.z);
+  U256 zi2 = mod_mul(zi, zi, FP);
+  x = mod_mul(p.x, zi2, FP);
+  y = mod_mul(p.y, mod_mul(zi2, zi, FP), FP);
+  return true;
+}
+
+// ───────────────────────────── ECDSA ───────────────────────────────
+
+// Recover affine pubkey from (msg_hash, r, s, recid). Returns false on fail.
+static bool ecdsa_recover(const uint8_t msg_hash[32], const U256& r,
+                          const U256& s, int recid, U256& qx, U256& qy) {
+  U256 zero = {{0, 0, 0, 0}};
+  if (u256_is_zero(r) || u256_is_zero(s)) return false;
+  if (u256_cmp(r, FN.m) >= 0 || u256_cmp(s, FN.m) >= 0) return false;
+  if (recid < 0 || recid > 3) return false;
+  U256 x = r;
+  if (recid & 2) {
+    uint64_t carry = u256_add(x, x, FN.m);
+    if (carry || u256_cmp(x, FP.m) >= 0) return false;
+  }
+  // alpha = x^3 + 7 mod p
+  U256 alpha = mod_mul(mod_mul(x, x, FP), x, FP);
+  U256 seven = {{7, 0, 0, 0}};
+  alpha = mod_add(alpha, seven, FP);
+  // y = alpha^((p+1)/4)
+  U256 e = FP.m;  // (p+1)/4: p ≡ 3 mod 4
+  U256 one = {{1, 0, 0, 0}};
+  u256_add(e, e, one);
+  // shift right by 2
+  for (int sh = 0; sh < 2; sh++) {
+    uint64_t carry = 0;
+    for (int i = 3; i >= 0; i--) {
+      uint64_t next = e.v[i] & 1;
+      e.v[i] = (e.v[i] >> 1) | (carry << 63);
+      carry = next;
+    }
+  }
+  U256 y = mod_pow(alpha, e, FP);
+  if (u256_cmp(mod_mul(y, y, FP), alpha) != 0) return false;
+  if ((y.v[0] & 1) != (uint64_t)(recid & 1)) y = mod_sub(FP.m, y, FP);
+
+  U256 z = u256_from_be(msg_hash);
+  // z mod n (one conditional subtract is enough: z < 2^256 < 2n)
+  if (u256_cmp(z, FN.m) >= 0) u256_sub(z, z, FN.m);
+  U256 r_inv = fn_inv(r);
+  U256 neg_z = u256_is_zero(z) ? zero : mod_sub(FN.m, z, FN);
+  Point R = {x, y, {{1, 0, 0, 0}}};
+  Point sr = pt_mul(R, s);
+  Point zg = g_mul(neg_z);
+  Point q = pt_mul(pt_add(sr, zg), r_inv);
+  return pt_to_affine(q, qx, qy);
+}
+
+// RFC 6979 deterministic nonce.
+static U256 rfc6979_k(const uint8_t msg_hash[32], const uint8_t priv[32]) {
+  uint8_t v[32], k[32];
+  memset(v, 0x01, 32);
+  memset(k, 0x00, 32);
+  uint8_t sep0 = 0x00, sep1 = 0x01;
+  hmac_sha256(k, 32, v, 32, &sep0, 1, priv, 32, msg_hash, 32, k);
+  hmac_sha256(k, 32, v, 32, nullptr, 0, nullptr, 0, nullptr, 0, v);
+  hmac_sha256(k, 32, v, 32, &sep1, 1, priv, 32, msg_hash, 32, k);
+  hmac_sha256(k, 32, v, 32, nullptr, 0, nullptr, 0, nullptr, 0, v);
+  while (true) {
+    hmac_sha256(k, 32, v, 32, nullptr, 0, nullptr, 0, nullptr, 0, v);
+    U256 cand = u256_from_be(v);
+    if (!u256_is_zero(cand) && u256_cmp(cand, FN.m) < 0) return cand;
+    hmac_sha256(k, 32, v, 32, &sep0, 1, nullptr, 0, nullptr, 0, k);
+    hmac_sha256(k, 32, v, 32, nullptr, 0, nullptr, 0, nullptr, 0, v);
+  }
+}
+
+// Sign; returns recid in [0,3] with low-s normalisation.
+static bool ecdsa_sign(const uint8_t msg_hash[32], const uint8_t priv[32],
+                       U256& r_out, U256& s_out, int& recid_out) {
+  U256 d = u256_from_be(priv);
+  if (u256_is_zero(d) || u256_cmp(d, FN.m) >= 0) return false;
+  U256 z = u256_from_be(msg_hash);
+  if (u256_cmp(z, FN.m) >= 0) u256_sub(z, z, FN.m);
+  for (int attempt = 0; attempt < 64; attempt++) {
+    U256 k = rfc6979_k(msg_hash, priv);
+    U256 rx, ry;
+    if (!pt_to_affine(g_mul(k), rx, ry)) continue;
+    U256 r = rx;
+    if (u256_cmp(r, FN.m) >= 0) u256_sub(r, r, FN.m);
+    if (u256_is_zero(r)) continue;
+    U256 s = mod_mul(fn_inv(k), mod_add(z, mod_mul(r, d, FN), FN), FN);
+    if (u256_is_zero(s)) continue;
+    int recid = int(ry.v[0] & 1) | (u256_cmp(rx, FN.m) >= 0 ? 2 : 0);
+    // low-s
+    U256 half = FN.m;
+    uint64_t carry = 0;
+    for (int i = 3; i >= 0; i--) {
+      uint64_t next = half.v[i] & 1;
+      half.v[i] = (half.v[i] >> 1) | (carry << 63);
+      carry = next;
+    }
+    if (u256_cmp(s, half) > 0) {
+      s = mod_sub(FN.m, s, FN);
+      recid ^= 1;
+    }
+    r_out = r;
+    s_out = s;
+    recid_out = recid;
+    return true;
+  }
+  return false;
+}
+
+// ───────────────────────── Ethereum scheme ─────────────────────────
+
+static void eip191_hash(const uint8_t* payload, size_t len, uint8_t out[32]) {
+  char prefix[64];
+  int plen = snprintf(prefix, sizeof(prefix),
+                      "\x19""Ethereum Signed Message:\n%zu", len);
+  std::vector<uint8_t> buf(plen + len);
+  memcpy(buf.data(), prefix, plen);
+  memcpy(buf.data() + plen, payload, len);
+  keccak256(buf.data(), buf.size(), out);
+}
+
+static void address_from_pub(const U256& qx, const U256& qy, uint8_t out[20]) {
+  uint8_t pub[64], digest[32];
+  u256_to_be(qx, pub);
+  u256_to_be(qy, pub + 32);
+  keccak256(pub, 64, digest);
+  memcpy(out, digest + 12, 20);
+}
+
+// Verify one EIP-191 signature. Returns 1 valid, 0 address mismatch,
+// -1 malformed recovery byte, -2 recovery failed (the reference surfaces
+// -1/-2 as scheme errors and 0 as InvalidVoteSignature — distinct paths,
+// src/signing/ethereum.rs:66-97).
+static int eth_verify_one(const uint8_t identity[20], const uint8_t* payload,
+                          size_t len, const uint8_t sig[65]) {
+  U256 r = u256_from_be(sig);
+  U256 s = u256_from_be(sig + 32);
+  int v = sig[64];
+  if (v >= 27) v -= 27;
+  if (v > 1) return -1;
+  uint8_t digest[32];
+  eip191_hash(payload, len, digest);
+  U256 qx, qy;
+  if (!ecdsa_recover(digest, r, s, v, qx, qy)) return -2;
+  uint8_t addr[20];
+  address_from_pub(qx, qy, addr);
+  return memcmp(addr, identity, 20) == 0 ? 1 : 0;
+}
+
+// ─────────────────────── batch fan-out helper ──────────────────────
+
+// Split [0, count) across n_threads (0 = hardware concurrency); stay
+// single-threaded below min_parallel items where spawn cost dominates.
+template <typename Work>
+static void run_parallel(int64_t count, int n_threads, int64_t min_parallel,
+                         const Work& work) {
+  if (n_threads <= 0) n_threads = (int)std::thread::hardware_concurrency();
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads == 1 || count < min_parallel) {
+    work(0, count);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t chunk = (count + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; t++) {
+    int64_t lo = t * chunk, hi = std::min<int64_t>(count, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back(work, lo, hi);
+  }
+  for (auto& th : threads) th.join();
+}
+
+// ───────────────────────────── C ABI ───────────────────────────────
+
+extern "C" {
+
+void hg_sha256(const uint8_t* data, uint64_t len, uint8_t* out) {
+  sha256(data, len, out);
+}
+
+void hg_keccak256(const uint8_t* data, uint64_t len, uint8_t* out) {
+  keccak256(data, len, out);
+}
+
+// Batched hashing: items are concatenated in `data`, item i spans
+// [offsets[i], offsets[i+1]); digests land at out + 32*i.
+static void hash_batch(const uint8_t* data, const uint64_t* offsets,
+                       int64_t count, uint8_t* out, int n_threads,
+                       void (*fn)(const uint8_t*, size_t, uint8_t*)) {
+  run_parallel(count, n_threads, 64, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++)
+      fn(data + offsets[i], offsets[i + 1] - offsets[i], out + 32 * i);
+  });
+}
+
+void hg_sha256_batch(const uint8_t* data, const uint64_t* offsets,
+                     int64_t count, uint8_t* out, int n_threads) {
+  hash_batch(data, offsets, count, out, n_threads, sha256);
+}
+
+void hg_keccak256_batch(const uint8_t* data, const uint64_t* offsets,
+                        int64_t count, uint8_t* out, int n_threads) {
+  hash_batch(data, offsets, count, out, n_threads, keccak256);
+}
+
+// EIP-191 verify. identities: 20*i, payload spans offsets, sigs: 65*i.
+// results[i]: 1 valid, 0 address mismatch, 255 malformed recovery byte,
+// 254 recovery failed (the latter two map to scheme errors).
+void hg_eth_verify_batch(const uint8_t* identities, const uint8_t* payloads,
+                         const uint64_t* offsets, const uint8_t* sigs,
+                         int64_t count, uint8_t* results, int n_threads) {
+  build_g_table();
+  run_parallel(count, n_threads, 4, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++) {
+      int res = eth_verify_one(identities + 20 * i, payloads + offsets[i],
+                               offsets[i + 1] - offsets[i], sigs + 65 * i);
+      results[i] = res == -1 ? 255 : (res == -2 ? 254 : uint8_t(res));
+    }
+  });
+}
+
+int hg_eth_verify(const uint8_t* identity, const uint8_t* payload,
+                  uint64_t len, const uint8_t* sig) {
+  build_g_table();
+  return eth_verify_one(identity, payload, len, sig);
+}
+
+// Sign payload (EIP-191) with a 32-byte key; writes r||s||v (65 bytes).
+// Returns 0 on success.
+int hg_eth_sign(const uint8_t* priv, const uint8_t* payload, uint64_t len,
+                uint8_t* sig_out) {
+  build_g_table();
+  uint8_t digest[32];
+  eip191_hash(payload, len, digest);
+  U256 r, s;
+  int recid;
+  if (!ecdsa_sign(digest, priv, r, s, recid)) return 1;
+  u256_to_be(r, sig_out);
+  u256_to_be(s, sig_out + 32);
+  sig_out[64] = uint8_t(27 + (recid & 1));
+  return 0;
+}
+
+// Derive the Ethereum address for a private key. Returns 0 on success.
+int hg_eth_address(const uint8_t* priv, uint8_t* addr_out) {
+  build_g_table();
+  U256 d = u256_from_be(priv);
+  if (u256_is_zero(d) || u256_cmp(d, FN.m) >= 0) return 1;
+  U256 qx, qy;
+  if (!pt_to_affine(g_mul(d), qx, qy)) return 1;
+  address_from_pub(qx, qy, addr_out);
+  return 0;
+}
+
+int hg_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,117 @@
+"""Native C++ runtime: parity with the pure-Python crypto oracle.
+
+The native library must be a drop-in: byte-identical hashes, byte-identical
+deterministic signatures (RFC 6979), and the same verify verdicts/errors.
+Skipped wholesale when the runtime cannot be built/loaded (it is optional).
+"""
+
+import hashlib
+import os
+
+import pytest
+
+from hashgraph_tpu import native
+from hashgraph_tpu.errors import ConsensusSchemeError
+from hashgraph_tpu.signing._keccak import keccak256 as py_keccak256
+from hashgraph_tpu.signing.ethereum import EthereumConsensusSigner, eip191_hash
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native runtime unavailable"
+)
+
+
+def signer_with_seed(seed: int) -> EthereumConsensusSigner:
+    return EthereumConsensusSigner(seed.to_bytes(32, "big"))
+
+
+class TestHashing:
+    @pytest.mark.parametrize("length", [0, 1, 31, 32, 135, 136, 137, 500, 1000])
+    def test_keccak_parity(self, length):
+        data = bytes(range(256))[:length] if length <= 256 else os.urandom(length)
+        data = (data * (length // max(len(data), 1) + 1))[:length]
+        assert native.keccak256(data) == py_keccak256(data)
+
+    def test_sha256_batch(self):
+        items = [os.urandom(n) for n in (0, 10, 64, 100, 300)]
+        digests = native.sha256_batch(items)
+        for item, digest in zip(items, digests):
+            assert digest.tobytes() == hashlib.sha256(item).digest()
+
+    def test_keccak_batch(self):
+        items = [os.urandom(n) for n in (5, 200)]
+        digests = native.keccak256_batch(items)
+        for item, digest in zip(items, digests):
+            assert digest.tobytes() == py_keccak256(item)
+
+
+class TestEcdsa:
+    @pytest.mark.parametrize("seed", [1, 2, 0xDEADBEEF, 2**200 + 7])
+    def test_sign_determinism_matches_python(self, seed):
+        """Native RFC 6979 signing must produce byte-identical signatures to
+        the Python implementation (both are deterministic)."""
+        signer = signer_with_seed(seed)
+        payload = b"payload-%d" % seed
+        native_sig = native.eth_sign(signer.private_key_bytes(), payload)
+        # Force the Python path for comparison.
+        from hashgraph_tpu.signing._secp256k1 import sign_recoverable
+
+        r, s, v = sign_recoverable(eip191_hash(payload), seed)
+        python_sig = (
+            r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([27 + (v & 1)])
+        )
+        assert native_sig == python_sig
+
+    def test_address_parity(self):
+        for seed in (1, 3, 2**128 + 5):
+            signer = signer_with_seed(seed)
+            assert native.eth_address(signer.private_key_bytes()) == signer.identity()
+
+    def test_verify_roundtrip_and_tamper(self):
+        signer = signer_with_seed(42)
+        payload = b"hello consensus"
+        sig = signer.sign(payload)
+        assert native.eth_verify(signer.identity(), payload, sig) == 1
+        other = signer_with_seed(43)
+        assert native.eth_verify(other.identity(), payload, sig) == 0
+        bad = bytearray(sig)
+        bad[5] ^= 0xFF
+        assert native.eth_verify(signer.identity(), payload, bytes(bad)) in (0, -2)
+
+    def test_scheme_uses_native_and_matches(self):
+        """EthereumConsensusSigner routes through native when available; its
+        observable behavior must be unchanged."""
+        signer = signer_with_seed(77)
+        payload = b"scheme-level"
+        sig = signer.sign(payload)
+        assert EthereumConsensusSigner.verify(signer.identity(), payload, sig)
+        assert not EthereumConsensusSigner.verify(
+            signer_with_seed(78).identity(), payload, sig
+        )
+        with pytest.raises(ConsensusSchemeError):
+            EthereumConsensusSigner.verify(signer.identity(), payload, sig[:10])
+
+    def test_verify_batch_mixed(self):
+        signers = [signer_with_seed(s) for s in (10, 11, 12, 13)]
+        payloads = [b"m%d" % i for i in range(4)]
+        sigs = [s.sign(p) for s, p in zip(signers, payloads)]
+        identities = [s.identity() for s in signers]
+        # Corrupt: wrong signer for #1, short signature for #2, bad recid #3.
+        identities[1] = signer_with_seed(99).identity()
+        sigs[2] = sigs[2][:30]
+        sigs[3] = sigs[3][:64] + bytes([99])
+        results = EthereumConsensusSigner.verify_batch(identities, payloads, sigs)
+        assert results[0] is True
+        assert results[1] is False
+        assert isinstance(results[2], ConsensusSchemeError)
+        assert isinstance(results[3], ConsensusSchemeError)
+
+    def test_batch_matches_scalar_loop(self):
+        signers = [signer_with_seed(s) for s in range(30, 36)]
+        payloads = [os.urandom(40) for _ in signers]
+        sigs = [s.sign(p) for s, p in zip(signers, payloads)]
+        identities = [s.identity() for s in signers]
+        batch = EthereumConsensusSigner.verify_batch(identities, payloads, sigs)
+        for i in range(len(signers)):
+            assert batch[i] is EthereumConsensusSigner.verify(
+                identities[i], payloads[i], sigs[i]
+            )
