@@ -48,6 +48,7 @@ from ..scope_config import ScopeConfig, ScopeConfigBuilder
 from ..service import DEFAULT_MAX_SESSIONS_PER_SCOPE, ConsensusStats
 from ..session import ConsensusConfig, ConsensusSession, ConsensusState
 from ..signing import ConsensusSignatureScheme
+from ..tracing import tracer as default_tracer
 from ..types import (
     ConsensusEvent,
     ConsensusFailedEvent,
@@ -131,6 +132,7 @@ class TpuConsensusEngine(Generic[Scope]):
                 voter_capacity if voter_capacity is not None else 64,
             )
         self._max_sessions_per_scope = max_sessions_per_scope
+        self.tracer = default_tracer
 
         self._records: dict[int, SessionRecord[Scope]] = {}  # slot -> record
         self._index: dict[tuple[Scope, int], int] = {}  # (scope, pid) -> slot
@@ -322,11 +324,32 @@ class TpuConsensusEngine(Generic[Scope]):
         (StatusCode.OK / ALREADY_REACHED are successes).
         """
         batch = len(items)
+        self.tracer.count("engine.votes_in", batch)
         statuses = np.zeros(batch, np.int32)
         dev_rows: list[int] = []  # indices into items that reach the device
         slots = np.empty(batch, np.int64)
         lanes = np.empty(batch, np.int32)
         values = np.empty(batch, bool)
+
+        # Batched signature verification: one scheme call for the whole batch
+        # (native runtime: one GIL-releasing threaded C call). Verdicts are
+        # injected into the per-vote check sequence, preserving exact scalar
+        # error precedence.
+        sig_verdicts: dict[int, object] = {}
+        if not pre_validated and batch > 1:
+            idxs = [
+                i
+                for i, (scope, vote) in enumerate(items)
+                if (scope, vote.proposal_id) in self._index
+            ]
+            if idxs:
+                with self.tracer.span("engine.verify_batch", votes=len(idxs)):
+                    verdicts = self._scheme.verify_batch(
+                        [items[i][1].vote_owner for i in idxs],
+                        [items[i][1].signing_payload() for i in idxs],
+                        [items[i][1].signature for i in idxs],
+                    )
+                sig_verdicts = dict(zip(idxs, verdicts))
 
         for i, (scope, vote) in enumerate(items):
             slot = self._index.get((scope, vote.proposal_id))
@@ -342,6 +365,7 @@ class TpuConsensusEngine(Generic[Scope]):
                         record.proposal.expiration_timestamp,
                         record.proposal.timestamp,
                         now,
+                        sig_verdict=sig_verdicts.get(i),
                     )
                 except ConsensusError as exc:
                     statuses[i] = int(exc.code)
@@ -361,10 +385,16 @@ class TpuConsensusEngine(Generic[Scope]):
             return statuses
 
         k = len(dev_rows)
-        dev_statuses, transitions = self._pool.ingest(
-            slots[:k], lanes[:k], values[:k], now
-        )
+        with self.tracer.span("engine.device_ingest", votes=k):
+            dev_statuses, transitions = self._pool.ingest(
+                slots[:k], lanes[:k], values[:k], now
+            )
         statuses[np.asarray(dev_rows)] = dev_statuses
+        self.tracer.count(
+            "engine.votes_accepted",
+            int(np.sum(dev_statuses == int(StatusCode.OK))),
+        )
+        self.tracer.count("engine.transitions", len(transitions))
 
         # Host bookkeeping for accepted votes, in arrival order; remember the
         # last accepted vote per slot — that is the vote that flipped a slot
@@ -450,6 +480,8 @@ class TpuConsensusEngine(Generic[Scope]):
             if self._pool.state_of(slot) == STATE_ACTIVE:
                 if self._pool.meta(slot).expiry <= now:
                     expired.append(slot)
+        self.tracer.count("engine.timeout_sweeps")
+        self.tracer.count("engine.timeouts_fired", len(expired))
         out: list[tuple[Scope, int, bool | None]] = []
         for slot, new_state in self._pool.timeout(expired):
             record = self._records[slot]

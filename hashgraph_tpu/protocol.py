@@ -126,11 +126,18 @@ def validate_vote(
     expiration_timestamp: int,
     creation_time: int,
     now: int,
+    sig_verdict=None,
 ) -> None:
     """Validate a single vote: structure, hash, signature, replay, expiry.
 
     Check order matters and mirrors the reference exactly
     (reference: src/utils.rs:127-171).
+
+    ``sig_verdict`` optionally injects a precomputed signature result from
+    the scheme's batched verification (bool, or the ConsensusSchemeError
+    ``verify`` would have raised) — the batch ingest path verifies all
+    signatures in one native call, then replays this check sequence per
+    vote. Semantics are identical to calling ``scheme.verify`` inline.
     """
     if not vote.vote_owner:
         raise EmptyVoteOwner()
@@ -143,7 +150,13 @@ def validate_vote(
     if vote.vote_hash != expected_hash:
         raise InvalidVoteHash()
 
-    if not scheme.verify(vote.vote_owner, vote.signing_payload(), vote.signature):
+    if sig_verdict is None:
+        sig_verdict = scheme.verify(
+            vote.vote_owner, vote.signing_payload(), vote.signature
+        )
+    if isinstance(sig_verdict, Exception):
+        raise sig_verdict
+    if not sig_verdict:
         raise InvalidVoteSignature()
 
     # Replay guard: the vote cannot predate the proposal

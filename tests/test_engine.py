@@ -250,6 +250,41 @@ class TestEngineBatchIngest:
         assert statuses[1] == int(StatusCode.INVALID_VOTE_SIGNATURE)
         assert statuses[2] == int(StatusCode.SESSION_NOT_FOUND)
 
+    def test_ethereum_batched_verification_path(self):
+        """Multi-vote batches route signature checks through the scheme's
+        verify_batch (native-accelerated for Ethereum); statuses must match
+        the scalar error precedence exactly."""
+        from hashgraph_tpu import EthereumConsensusSigner
+
+        engine = TpuConsensusEngine(
+            EthereumConsensusSigner.random(), capacity=8, voter_capacity=8
+        )
+        pid = engine.create_proposal("s", request(5, liveness=False), NOW).proposal_id
+        voters = [EthereumConsensusSigner.random() for _ in range(3)]
+        good0 = build_vote(engine.get_proposal("s", pid), True, voters[0], NOW)
+        engine.process_incoming_vote("s", good0, NOW)
+
+        base = engine.get_proposal("s", pid)
+        good1 = build_vote(base, False, voters[1], NOW)
+        forged = build_vote(base, True, voters[2], NOW)
+        # Flip a bit in r: recovery yields a different address (or fails).
+        forged.signature = bytes([forged.signature[0] ^ 1]) + forged.signature[1:]
+        short = build_vote(base, True, voters[2], NOW)
+        short.signature = short.signature[:10]
+        unsigned = build_vote(base, True, voters[2], NOW)
+        unsigned.signature = b""
+
+        statuses = engine.ingest_votes(
+            [("s", good1), ("s", forged), ("s", short), ("s", unsigned)], NOW
+        )
+        assert statuses[0] == int(StatusCode.OK)
+        assert statuses[1] in (
+            int(StatusCode.INVALID_VOTE_SIGNATURE),
+            int(StatusCode.SIGNATURE_SCHEME),
+        )
+        assert statuses[2] == int(StatusCode.SIGNATURE_SCHEME)  # bad length
+        assert statuses[3] == int(StatusCode.EMPTY_SIGNATURE)  # structural first
+
     def test_voter_capacity_exhaustion(self):
         engine = TpuConsensusEngine(
             random_stub_signer(), capacity=4, voter_capacity=4

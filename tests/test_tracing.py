@@ -1,0 +1,63 @@
+"""Tracing: counters, spans, export, and engine instrumentation."""
+
+import json
+
+from hashgraph_tpu.engine import TpuConsensusEngine
+from hashgraph_tpu.tracing import Tracer
+from hashgraph_tpu import CreateProposalRequest, build_vote
+
+from common import NOW, random_stub_signer
+
+
+class TestTracer:
+    def test_disabled_is_noop(self):
+        t = Tracer()
+        with t.span("x"):
+            pass
+        t.count("c", 5)
+        t.event("e")
+        assert t.counters() == {}
+        assert t.spans() == []
+
+    def test_spans_and_counters(self):
+        t = Tracer(enabled=True)
+        with t.span("work", size=3):
+            t.count("items", 3)
+        stats = t.span_stats("work")
+        assert stats["count"] == 1
+        assert stats["total"] > 0
+        assert t.counters()["items"] == 3
+        assert t.counters()["span.work.calls"] == 1
+
+    def test_export_jsonl(self, tmp_path):
+        t = Tracer(enabled=True)
+        with t.span("a"):
+            pass
+        t.event("boom", detail="x")
+        path = tmp_path / "trace.jsonl"
+        t.export_jsonl(str(path))
+        lines = [json.loads(line) for line in path.read_text().splitlines()]
+        kinds = {line["type"] for line in lines}
+        assert kinds == {"counters", "span", "event"}
+
+    def test_engine_instrumentation(self):
+        engine = TpuConsensusEngine(
+            random_stub_signer(), capacity=8, voter_capacity=8
+        )
+        engine.tracer = Tracer(enabled=True)
+        pid = engine.create_proposal(
+            "s",
+            CreateProposalRequest("p", b"", b"o", 3, 100, True),
+            NOW,
+        ).proposal_id
+        v1 = build_vote(engine.get_proposal("s", pid), True, random_stub_signer(), NOW)
+        v2 = build_vote(engine.get_proposal("s", pid), True, random_stub_signer(), NOW)
+        engine.ingest_votes([("s", v1)], NOW)
+        engine.ingest_votes([("s", v2)], NOW)
+        engine.sweep_timeouts(NOW + 200)
+        counters = engine.tracer.counters()
+        assert counters["engine.votes_in"] == 2
+        assert counters["engine.votes_accepted"] == 2
+        assert counters["engine.transitions"] == 1  # second vote decided
+        assert counters["engine.timeout_sweeps"] == 1
+        assert counters["span.engine.device_ingest.calls"] == 2
