@@ -202,6 +202,97 @@ class TpuConsensusEngine(Generic[Scope]):
             )
         self._register_session(scope, session, now)
 
+    def ingest_proposals(
+        self, items: list[tuple[Scope, Proposal]], now: int
+    ) -> list[int]:
+        """Batch counterpart of process_incoming_proposal: validate and load
+        many (possibly vote-carrying) proposals in bulk.
+
+        The expensive per-vote work is batched — ALL embedded signatures go
+        through one scheme.verify_batch call (native threaded path) and ALL
+        chains with >1 votes through one vmapped device chain kernel — then
+        each proposal replays the exact scalar check sequence with the
+        precomputed verdicts injected, so error precedence is identical to
+        the scalar path. Returns one StatusCode per item (OK = registered;
+        events emitted exactly as the scalar path would).
+        """
+        from ..ops.chain import chain_kernel_batch, first_chain_error, pack_chain
+
+        statuses = [int(StatusCode.OK)] * len(items)
+
+        # Bulk signature verification across every embedded vote.
+        flat_ids: list[bytes] = []
+        flat_payloads: list[bytes] = []
+        flat_sigs: list[bytes] = []
+        spans: list[tuple[int, int]] = []  # (start, count) per item
+        for scope, proposal in items:
+            start = len(flat_ids)
+            for vote in proposal.votes:
+                flat_ids.append(vote.vote_owner)
+                flat_payloads.append(vote.signing_payload())
+                flat_sigs.append(vote.signature)
+            spans.append((start, len(proposal.votes)))
+        verdicts: list = []
+        if flat_ids:
+            with self.tracer.span("engine.verify_batch", votes=len(flat_ids)):
+                verdicts = self._scheme.verify_batch(
+                    flat_ids, flat_payloads, flat_sigs
+                )
+
+        # Bulk chain validation on device (only chains that need it).
+        chain_errors: dict[int, ConsensusError | None] = {}
+        chain_idx = [i for i, (_, p) in enumerate(items) if len(p.votes) > 1]
+        if chain_idx:
+            pad = max(len(items[i][1].votes) for i in chain_idx)
+            packs = [pack_chain(items[i][1].votes, pad_to=pad) for i in chain_idx]
+            batchpack = {
+                key: np.stack([p[key] for p in packs]) for key in packs[0]
+            }
+            with self.tracer.span("engine.chain_kernel", chains=len(chain_idx)):
+                chain_statuses = np.asarray(
+                    chain_kernel_batch(
+                        batchpack["vote_hash"],
+                        batchpack["received_hash"],
+                        batchpack["parent_hash"],
+                        batchpack["owner"],
+                        batchpack["ts"],
+                        batchpack["valid"],
+                    )
+                )
+            for j, i in enumerate(chain_idx):
+                code = first_chain_error(chain_statuses[j])
+                exc_cls = error_for_code(code) if code else None
+                chain_errors[i] = exc_cls() if exc_cls is not None else None
+
+        for i, (scope, proposal) in enumerate(items):
+            if (scope, proposal.proposal_id) in self._index:
+                statuses[i] = int(StatusCode.PROPOSAL_ALREADY_EXIST)
+                continue
+            start, count = spans[i]
+            try:
+                config = self._resolve_config(scope, None, proposal)
+                session, transition = ConsensusSession.from_proposal(
+                    proposal.clone(),
+                    self._scheme,
+                    config,
+                    now,
+                    sig_verdicts=verdicts[start : start + count] if count else None,
+                    chain_error=chain_errors.get(i),
+                )
+                if transition.is_reached:
+                    self._emit(
+                        scope,
+                        ConsensusReached(
+                            proposal_id=proposal.proposal_id,
+                            result=transition.reached,
+                            timestamp=now,
+                        ),
+                    )
+                self._register_session(scope, session, now)
+            except ConsensusError as exc:
+                statuses[i] = int(exc.code)
+        return statuses
+
     def _register(
         self,
         scope: Scope,
@@ -235,21 +326,23 @@ class TpuConsensusEngine(Generic[Scope]):
         return record
 
     def _register_session(
-        self, scope: Scope, session: ConsensusSession, now: int
+        self, scope: Scope, session: ConsensusSession, created_at: int
     ) -> None:
-        """Load a replayed scalar session (possibly already decided) into a
-        fresh slot."""
+        """Load a scalar session (possibly already decided) into a fresh
+        slot — the shared path for validated network proposals and
+        storage-backed restore (device tensors are a cache; the session is
+        the source of truth, SURVEY §5 checkpoint row)."""
         proposal = session.proposal
         if len(session.votes) > self._pool.voter_capacity:
             # Reject before touching the pool: nothing to roll back.
             raise VoterCapacityExceeded(
                 "embedded vote chain exceeds pool voter capacity"
             )
-        record = self._register(scope, proposal, session.config, now)
+        record = self._register(scope, proposal, session.config, created_at)
         if record.slot not in self._records:
             return  # evicted immediately by the per-scope cap (created_at tie)
         record.votes = {k: v.clone() for k, v in session.votes.items()}
-        if session.votes:
+        if session.votes or not session.state.is_active:
             meta = self._pool.meta(record.slot)
             vcap = self._pool.voter_capacity
             mask = np.zeros((1, vcap), bool)
@@ -558,6 +651,45 @@ class TpuConsensusEngine(Generic[Scope]):
             created_at=record.created_at,
             config=record.config,
         )
+
+    # ── Checkpoint / resume (SURVEY §5: host storage is the source of
+    #    truth; device tensors are a rebuildable cache) ─────────────────
+
+    def save_to_storage(self, storage) -> int:
+        """Persist every tracked session (and scope configs) into a
+        ConsensusStorage backend — the reference's durability abstraction
+        (src/storage.rs:18-22). Returns the number of sessions written."""
+        count = 0
+        for scope, slots in self._scopes.items():
+            for slot in slots:
+                record = self._records[slot]
+                storage.save_session(
+                    scope, self.export_session(scope, record.proposal.proposal_id)
+                )
+                count += 1
+        for scope, config in self._scope_configs.items():
+            storage.set_scope_config(scope, config.clone())
+        return count
+
+    def load_from_storage(self, storage) -> int:
+        """Rebuild pool state from a ConsensusStorage backend: every stored
+        session is loaded into a fresh slot with its original created_at,
+        tallies, lanes, and lifecycle state (no re-validation — storage is
+        trusted, exactly as the reference trusts its own persisted sessions).
+        Returns the number of sessions loaded."""
+        count = 0
+        scopes = storage.list_scopes() or []
+        for scope in scopes:
+            config = storage.get_scope_config(scope)
+            if config is not None:
+                self._scope_configs[scope] = config.clone()
+            sessions = storage.list_scope_sessions(scope) or []
+            for session in sorted(sessions, key=lambda s: s.created_at):
+                if (scope, session.proposal.proposal_id) in self._index:
+                    continue  # already tracked (idempotent restore)
+                self._register_session(scope, session.clone(), session.created_at)
+                count += 1
+        return count
 
     def delete_scope(self, scope: Scope) -> None:
         """Drop every session and the config of a scope

@@ -110,14 +110,41 @@ def build_vote(
     return vote
 
 
-def validate_proposal(proposal: Proposal, scheme, now: int) -> None:
-    """Validate a proposal and all its votes (reference: src/utils.rs:106-120)."""
+# Sentinel: "compute the chain check here" (vs an injected device result).
+COMPUTE_CHAIN = object()
+
+
+def validate_proposal(
+    proposal: Proposal,
+    scheme,
+    now: int,
+    sig_verdicts=None,
+    chain_error=COMPUTE_CHAIN,
+) -> None:
+    """Validate a proposal and all its votes (reference: src/utils.rs:106-120).
+
+    ``sig_verdicts``/``chain_error`` optionally inject precomputed results
+    from the batched paths (scheme.verify_batch / the device chain kernel):
+    ``sig_verdicts`` is one verdict per vote in order; ``chain_error`` is
+    None (chain valid) or the exception to raise at the chain-check
+    position. Injection changes where the work happens, not the semantics.
+    """
     validate_proposal_timestamp(proposal.expiration_timestamp, now)
-    for vote in proposal.votes:
+    for i, vote in enumerate(proposal.votes):
         if vote.proposal_id != proposal.proposal_id:
             raise VoteProposalIdMismatch()
-        validate_vote(vote, scheme, proposal.expiration_timestamp, proposal.timestamp, now)
-    validate_vote_chain(proposal.votes)
+        validate_vote(
+            vote,
+            scheme,
+            proposal.expiration_timestamp,
+            proposal.timestamp,
+            now,
+            sig_verdict=sig_verdicts[i] if sig_verdicts is not None else None,
+        )
+    if chain_error is COMPUTE_CHAIN:
+        validate_vote_chain(proposal.votes)
+    elif chain_error is not None:
+        raise chain_error
 
 
 def validate_vote(

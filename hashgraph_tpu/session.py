@@ -19,6 +19,7 @@ from .errors import (
     SessionNotActive,
 )
 from .protocol import (
+    COMPUTE_CHAIN,
     calculate_consensus_result,
     calculate_max_rounds,
     validate_proposal,
@@ -192,11 +193,16 @@ class ConsensusSession:
         scheme,
         config: ConsensusConfig,
         now: int,
+        sig_verdicts=None,
+        chain_error=COMPUTE_CHAIN,
     ) -> tuple["ConsensusSession", SessionTransition]:
         """Validate a (possibly vote-carrying) proposal and build a session,
         replaying embedded votes from a clean round-1 state
-        (reference: src/session.rs:198-221)."""
-        validate_proposal(proposal, scheme, now)
+        (reference: src/session.rs:198-221). ``sig_verdicts``/``chain_error``
+        inject batched-path results (see protocol.validate_proposal)."""
+        validate_proposal(
+            proposal, scheme, now, sig_verdicts=sig_verdicts, chain_error=chain_error
+        )
 
         existing_votes = [v.clone() for v in proposal.votes]
         clean_proposal = proposal.clone()
@@ -210,6 +216,8 @@ class ConsensusSession:
             proposal.expiration_timestamp,
             proposal.timestamp,
             now,
+            sig_verdicts=sig_verdicts,
+            chain_error=chain_error,
         )
         return session, transition
 
@@ -239,6 +247,8 @@ class ConsensusSession:
         expiration_timestamp: int,
         creation_time: int,
         now: int,
+        sig_verdicts=None,
+        chain_error=COMPUTE_CHAIN,
     ) -> SessionTransition:
         """Batch-initialize: validate everything, then add atomically
         (reference: src/session.rs:253-298)."""
@@ -261,9 +271,19 @@ class ConsensusSession:
             self.state = ConsensusState.failed()
             raise MaxRoundsExceeded()
 
-        validate_vote_chain(votes)
-        for vote in votes:
-            validate_vote(vote, scheme, expiration_timestamp, creation_time, now)
+        if chain_error is COMPUTE_CHAIN:
+            validate_vote_chain(votes)
+        elif chain_error is not None:
+            raise chain_error
+        for i, vote in enumerate(votes):
+            validate_vote(
+                vote,
+                scheme,
+                expiration_timestamp,
+                creation_time,
+                now,
+                sig_verdict=sig_verdicts[i] if sig_verdicts is not None else None,
+            )
 
         self._check_round_limit(len(votes))
         self._update_round(len(votes))
