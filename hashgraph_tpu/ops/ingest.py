@@ -221,7 +221,9 @@ def ingest_body(
     vote_mask = scatter(vote_mask, row_mask)
     vote_val = scatter(vote_val, row_val)
 
-    out = jnp.concatenate([statuses, row_state[:, None]], axis=1)
+    # int8 readback: status codes fit a byte, and the device->host link is
+    # the bottleneck — 4x less transfer than int32.
+    out = jnp.concatenate([statuses, row_state[:, None]], axis=1).astype(jnp.int8)
     return state, yes, tot, vote_mask, vote_val, out
 
 
