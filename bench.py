@@ -1,16 +1,21 @@
-"""Benchmark: batched vote-ingest throughput on the device pool.
+"""Benchmarks over the device pool (BASELINE.md configs).
 
-BASELINE config 3 shape: 10k concurrent proposals × 64 voters, batched tally
-on a single TPU core. The trace is a pre-validated replay (signature/hash
-verification is the pluggable host stage, benchmarked separately; the
+Default (bare ``python bench.py``) runs config 3 — 10k concurrent proposals
+× 64 voters, batched tally, single TPU core — and prints ONE JSON line:
+votes ingested/sec vs the 1M/s north-star baseline. Other configs via argv:
+
+  python bench.py config2   # 1 proposal x 1024 voters, P2P: finality latency
+  python bench.py config4   # scopes x proposals x 256 voters, 30% absent,
+                            # liveness-timeout path (sharded when >1 device)
+  python bench.py config5   # streaming mixed Gossipsub+P2P replay
+  python bench.py all
+
+Traces are pre-validated replays (signature/hash verification is the
+pluggable host stage, benchmarked separately in tests/test_native.py; the
 reference's own tests hand-deliver already-validated votes the same way) —
-this measures the consensus engine proper: packed transfer → scatter →
-arrival-ordered scan → fused decision kernel → status readback, via the same
-ProposalPool ingest path the engine uses in production, pipelined the way a
-streaming embedder would drive it (dispatches in flight, one batched
-completion).
-
-Prints ONE JSON line: votes ingested/sec vs the 1M/s north-star baseline.
+these measure the consensus engine proper: packed transfer → scatter →
+arrival-ordered scan → fused decision kernel → status readback, pipelined
+the way a streaming embedder would drive it.
 """
 
 from __future__ import annotations
@@ -108,5 +113,214 @@ def run_bench(
     }
 
 
+def run_config2(voters: int = 1024, repeats: int = 9) -> dict:
+    """1 proposal × 1024 voters, P2P dynamic rounds: p50 finality latency.
+
+    The P2P cap is ceil(2n/3) votes; a unanimous YES replay decides at
+    req = ceil(2n/3) = 683 votes. The whole chain arrives as one dispatch
+    (scan depth = 683), timing first-vote-to-decision wall clock.
+    """
+    import jax
+
+    from hashgraph_tpu.engine.pool import ProposalPool
+    from hashgraph_tpu.ops.decide import STATE_REACHED_YES, required_votes_np
+
+    now = 1_700_000_000
+    cap = (2 * voters + 2) // 3
+    pool = ProposalPool(8, voters)
+    latencies = []
+    for rep in range(repeats + 1):  # first is compile warmup
+        pool.allocate_batch(
+            keys=[(rep, 0)],
+            n=np.array([voters]),
+            req=required_votes_np(np.array([voters]), 2.0 / 3.0),
+            cap=np.array([cap]),
+            gossip=np.array([False]),
+            liveness=np.array([True]),
+            expiry=np.array([now + 1000]),
+            created_at=np.array([now]),
+        )
+        slots = np.zeros(cap, np.int64)
+        lanes = np.arange(cap, dtype=np.int32)
+        values = np.ones(cap, bool)
+        start = time.perf_counter()
+        statuses, transitions = pool.ingest(slots, lanes, values, now)
+        latency = time.perf_counter() - start
+        assert transitions and transitions[0][1] == STATE_REACHED_YES
+        if rep > 0:
+            latencies.append(latency)
+        pool.release([0])
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2]
+    return {
+        "metric": "p2p_finality_latency_p50",
+        "value": round(p50 * 1000, 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "detail": {
+            "voters": voters,
+            "votes_to_quorum": cap,
+            "latencies_ms": [round(l * 1000, 2) for l in latencies],
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
+def run_config4(
+    scopes: int = 64, proposals_per_scope: int = 256, voters: int = 256
+) -> dict:
+    """Byzantine/absent liveness path: 30% of voters never vote; sessions
+    finalize via the timeout sweep. Sharded over all available devices."""
+    import jax
+
+    from hashgraph_tpu.engine.pool import ProposalPool
+    from hashgraph_tpu.ops.decide import (
+        STATE_ACTIVE,
+        required_votes_np,
+    )
+    from hashgraph_tpu.parallel import ShardedPool, consensus_mesh
+
+    rng = np.random.default_rng(11)
+    now = 1_700_000_000
+    p_count = scopes * proposals_per_scope
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        per_dev = -(-p_count // n_dev)
+        pool = ShardedPool(per_dev, voters, consensus_mesh())
+    else:
+        pool = ProposalPool(p_count, voters)
+
+    pool.allocate_batch(
+        keys=[(f"s{i % scopes}", i) for i in range(p_count)],
+        n=np.full(p_count, voters),
+        req=required_votes_np(np.full(p_count, voters), 2.0 / 3.0),
+        cap=np.full(p_count, 2),
+        gossip=np.ones(p_count, bool),
+        liveness=rng.random(p_count) < 0.5,
+        expiry=np.full(p_count, now + 100),
+        created_at=np.full(p_count, now),
+    )
+
+    # 70% participation, random yes/no, streamed in lane-rounds.
+    present = int(voters * 0.7)
+    slots = np.repeat(np.arange(p_count, dtype=np.int64), 8)
+    start = time.perf_counter()
+    total_votes = 0
+    pendings = []
+    for base_lane in range(0, present, 8):
+        width = min(8, present - base_lane)
+        sl = np.repeat(np.arange(p_count, dtype=np.int64), width)
+        lanes = np.tile(
+            np.arange(base_lane, base_lane + width, dtype=np.int32), p_count
+        )
+        values = rng.random(p_count * width) < 0.5
+        pendings.append(pool.ingest_async(sl, lanes, values, now))
+        total_votes += p_count * width
+    pool.complete_all(pendings)
+    # Liveness sweep finalizes everything still active.
+    active = [s for s in range(p_count) if pool.state_of(s) == STATE_ACTIVE]
+    swept = pool.timeout(active)
+    elapsed = time.perf_counter() - start
+
+    undecided = sum(1 for _, st in swept if st == STATE_ACTIVE)
+    throughput = total_votes / elapsed
+    return {
+        "metric": "byzantine_timeout_throughput",
+        "value": round(throughput, 1),
+        "unit": "votes/sec",
+        "vs_baseline": round(throughput / 1_000_000, 4),
+        "detail": {
+            "scopes": scopes,
+            "proposals": p_count,
+            "voters": voters,
+            "absent_pct": 30,
+            "votes": total_votes,
+            "timeout_decisions": len(swept),
+            "undecided_after_sweep": undecided,
+            "seconds": round(elapsed, 3),
+            "devices": n_dev,
+        },
+    }
+
+
+def run_config5(p_count: int = 65_536, v_count: int = 48) -> dict:
+    """Streaming mixed Gossipsub+P2P replay: a large arrival-ordered trace
+    applied through the pipelined ingest path (config-5 scaled to one chip;
+    the full 1M-proposal replay is this shape run repeatedly)."""
+    import jax
+
+    from hashgraph_tpu.engine.pool import ProposalPool
+    from hashgraph_tpu.ops.decide import required_votes_np
+
+    rng = np.random.default_rng(23)
+    now = 1_700_000_000
+    pool = ProposalPool(p_count, v_count)
+
+    gossip = rng.random(p_count) < 0.5
+    caps = np.where(gossip, 2, (2 * v_count + 2) // 3)
+    pool.allocate_batch(
+        keys=[("stream", i) for i in range(p_count)],
+        n=np.full(p_count, v_count),
+        req=required_votes_np(np.full(p_count, v_count), 2.0 / 3.0),
+        cap=caps,
+        gossip=gossip,
+        liveness=rng.random(p_count) < 0.5,
+        expiry=np.full(p_count, now + 10_000),
+        created_at=np.full(p_count, now),
+    )
+
+    # Stream rounds of one-vote-per-proposal through the full voter set:
+    # gossip sessions decide once quorum lands (~vote 32 of 48), P2P
+    # sessions hit their ceil(2n/3) caps, and later rounds exercise the
+    # ALREADY_REACHED / SESSION_NOT_ACTIVE absorption paths — exactly like
+    # a replayed gossip trace.
+    rounds = v_count
+    total_votes = 0
+    start = time.perf_counter()
+    pendings = []
+    slots = np.arange(p_count, dtype=np.int64)
+    for r in range(rounds):
+        lanes = np.full(p_count, r, np.int32)
+        values = rng.random(p_count) < 0.55
+        pendings.append(pool.ingest_async(slots, lanes, values, now))
+        total_votes += p_count
+        if len(pendings) >= 8:
+            pool.complete_all(pendings)
+            pendings = []
+    if pendings:
+        pool.complete_all(pendings)
+    elapsed = time.perf_counter() - start
+
+    counts = pool.state_counts()
+    throughput = total_votes / elapsed
+    return {
+        "metric": "streaming_mixed_replay_throughput",
+        "value": round(throughput, 1),
+        "unit": "votes/sec",
+        "vs_baseline": round(throughput / 1_000_000, 4),
+        "detail": {
+            "proposals": p_count,
+            "voters": v_count,
+            "votes": total_votes,
+            "seconds": round(elapsed, 3),
+            "final_state_counts": {str(k): v for k, v in counts.items()},
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
 if __name__ == "__main__":
-    print(json.dumps(run_bench()))
+    import sys
+
+    which = sys.argv[1] if len(sys.argv) > 1 else "config3"
+    runners = {
+        "config2": run_config2,
+        "config3": run_bench,
+        "config4": run_config4,
+        "config5": run_config5,
+    }
+    if which == "all":
+        for name, fn in runners.items():
+            print(json.dumps(fn()))
+    else:
+        print(json.dumps(runners[which]()))
