@@ -25,6 +25,7 @@ Design notes (TPU):
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Hashable
@@ -198,11 +199,27 @@ class ProposalPool:
     readbacks.
     """
 
-    def __init__(self, capacity: int, voter_capacity: int):
+    def __init__(
+        self, capacity: int, voter_capacity: int, use_pallas: bool | None = None
+    ):
         if capacity < 1 or voter_capacity < 1:
             raise ValueError("capacity and voter_capacity must be >= 1")
         self.capacity = capacity
         self.voter_capacity = voter_capacity
+        if use_pallas is None:
+            use_pallas = os.environ.get("HASHGRAPH_TPU_PALLAS", "") == "1"
+        self._ingest_kernel = ingest_kernel
+        if use_pallas:
+            from ..ops.pallas_ingest import pallas_ingest_body
+
+            self._ingest_kernel = partial(
+                jax.jit, donate_argnums=(0, 1, 2, 3, 4)
+            )(
+                partial(
+                    pallas_ingest_body,
+                    interpret=jax.default_backend() != "tpu",
+                )
+            )
         self._init_device_arrays()
 
         # Host mirrors / bookkeeping.
@@ -549,7 +566,7 @@ class ProposalPool:
             self._vote_mask,
             self._vote_val,
             out,
-        ) = ingest_kernel(
+        ) = self._ingest_kernel(
             self._state,
             self._yes,
             self._tot,

@@ -1,0 +1,265 @@
+"""Pallas TPU kernel for the ingest vote-scan (the hot loop).
+
+The XLA path (:func:`hashgraph_tpu.ops.ingest.ingest_body`) expresses the
+arrival-ordered vote replay as ``lax.scan`` whose carry — the ``[S, V]``
+mask/value rows plus tallies — may round-trip HBM between steps. This Pallas
+version keeps each block's carry resident in VMEM for all ``L`` steps: the
+grid tiles the touched-slot axis, each program loads its rows once, loops
+votes with a ``fori_loop`` entirely on-chip (VPU; the per-row lane update is
+a one-hot compare against an iota, not a scatter), and writes back once.
+
+Layout notes (TPU tiling):
+- per-row scalars (state/yes/tot/n/req/cap/gossip/liveness/expired) pack
+  into one ``int32[S, 16]`` array → a single VMEM block per program;
+- masks/values are ``int32[S, V]`` (bool semantics; int32 keeps the 8×128
+  tile layout);
+- the semantics are bit-identical to the XLA scan — enforced by the parity
+  suite which runs both on identical inputs.
+
+Used by the pool when ``HASHGRAPH_TPU_PALLAS=1`` (or ``use_pallas=True``);
+falls back to the XLA path automatically if lowering fails. On non-TPU
+backends tests run it with ``interpret=True``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ..errors import StatusCode
+from .decide import (
+    STATE_ACTIVE,
+    STATE_FAILED,
+    STATE_REACHED_NO,
+    STATE_REACHED_YES,
+)
+from .ingest import PAD_STATUS
+
+# Packed per-row scalar columns.
+_C_STATE, _C_YES, _C_TOT, _C_N, _C_REQ, _C_CAP, _C_GOSSIP, _C_LIVE, _C_EXPIRED = range(9)
+SCALAR_COLS = 16  # padded for tiling friendliness
+
+_OK = int(StatusCode.OK)
+_ALREADY = int(StatusCode.ALREADY_REACHED)
+_NOT_ACTIVE = int(StatusCode.SESSION_NOT_ACTIVE)
+_EXPIRED = int(StatusCode.PROPOSAL_EXPIRED)
+_MAX_ROUNDS = int(StatusCode.MAX_ROUNDS_EXCEEDED)
+_DUP = int(StatusCode.DUPLICATE_VOTE)
+
+_LANE_MASK = (1 << 16) - 1
+_VAL_BIT = 16
+_VALID_BIT = 17
+
+
+def _decide_vec(yes, tot, n, req, live):
+    """Vectorized calculate_consensus_result with is_timeout=False
+    (mirrors ops.decide.decide_kernel; kernel-local form with int32 truth
+    values throughout — Mosaic cannot select over packed-bool vectors, so
+    no jnp.where may carry boolean branches)."""
+    i32 = jnp.int32
+    no = tot - yes
+    silent = jnp.maximum(n - tot, 0)
+    small = (n <= 2).astype(i32)
+    small_decided = (tot >= n).astype(i32)
+    small_result = (yes == n).astype(i32)
+    gate = (tot >= req).astype(i32)
+    live_i = live.astype(i32)
+    yes_w = yes + silent * live_i
+    no_w = no + silent * (1 - live_i)
+    yes_win = ((yes_w >= req) & (yes_w > no_w)).astype(i32)
+    no_win = ((no_w >= req) & (no_w > yes_w)).astype(i32)
+    tie = ((tot == n) & (yes_w == no_w)).astype(i32)
+    big_decided = gate * jnp.minimum(yes_win + no_win + tie, 1)
+    big_result = jnp.minimum(yes_win + (1 - no_win) * (1 - yes_win) * live_i, 1)
+    decided = small * small_decided + (1 - small) * big_decided
+    result = small * small_result + (1 - small) * big_result
+    return decided, result
+
+
+def _ingest_block_kernel(scal_ref, mask_ref, val_ref, grid_ref,
+                         out_scal_ref, out_mask_ref, out_val_ref, out_status_ref):
+    scal = scal_ref[...]  # [B, 16] int32
+    mask = mask_ref[...]  # [B, V] int32 (0/1)
+    vals = val_ref[...]
+    grid = grid_ref[...]  # [B, L] packed votes
+    b, v_cap = mask.shape
+    l_depth = grid.shape[1]
+
+    state = scal[:, _C_STATE]
+    yes = scal[:, _C_YES]
+    tot = scal[:, _C_TOT]
+    n = scal[:, _C_N]
+    req = scal[:, _C_REQ]
+    cap = scal[:, _C_CAP]
+    gossip = scal[:, _C_GOSSIP] != 0
+    live = scal[:, _C_LIVE] != 0
+    expired = scal[:, _C_EXPIRED] != 0
+
+    lane_iota = lax.broadcasted_iota(jnp.int32, (b, v_cap), 1)
+    col_iota = lax.broadcasted_iota(jnp.int32, (b, l_depth), 1)
+    statuses0 = jnp.full((b, l_depth), PAD_STATUS, jnp.int32)
+
+    def step(l, carry):
+        state, yes, tot, mask, vals, statuses = carry
+        # Column l of the grid via one-hot select (Pallas TPU lowers no
+        # dynamic_slice; L is small so the O(L) select is free on the VPU).
+        cell = jnp.sum(jnp.where(col_iota == l, grid, 0), axis=1)  # [B]
+        voter = cell & _LANE_MASK
+        val = ((cell >> _VAL_BIT) & 1) != 0
+        valid = ((cell >> _VALID_BIT) & 1) != 0
+
+        reached = (state == STATE_REACHED_YES) | (state == STATE_REACHED_NO)
+        active = state == STATE_ACTIVE
+        projected = jnp.where(gossip, 2, tot + 1)
+        exceeded = projected > cap
+        onehot = lane_iota == voter[:, None]  # [B, V]
+        dup = jnp.sum(jnp.where(onehot, mask, 0), axis=1) != 0
+
+        ok = valid & active & ~expired & ~exceeded & ~dup
+        status = jnp.where(
+            ~valid,
+            PAD_STATUS,
+            jnp.where(
+                reached,
+                _ALREADY,
+                jnp.where(
+                    ~active,
+                    _NOT_ACTIVE,
+                    jnp.where(
+                        expired,
+                        _EXPIRED,
+                        jnp.where(
+                            exceeded,
+                            _MAX_ROUNDS,
+                            jnp.where(dup, _DUP, _OK),
+                        ),
+                    ),
+                ),
+            ),
+        ).astype(jnp.int32)
+
+        state = jnp.where(valid & active & ~expired & exceeded, STATE_FAILED, state)
+        tot = tot + ok.astype(tot.dtype)
+        yes = yes + (ok & val).astype(yes.dtype)
+        set_mask = onehot & ok[:, None]
+        mask = jnp.where(set_mask, 1, mask)
+        vals = jnp.where(set_mask & val[:, None], 1, jnp.where(set_mask, 0, vals))
+
+        decided, result = _decide_vec(yes, tot, n, req, live)  # int32 0/1
+        newly = ok & (decided != 0)
+        reached_state = jnp.where(result != 0, STATE_REACHED_YES, STATE_REACHED_NO)
+        state = jnp.where(newly, reached_state.astype(state.dtype), state)
+
+        statuses = jnp.where(col_iota == l, status[:, None], statuses)
+        return state, yes, tot, mask, vals, statuses
+
+    state, yes, tot, mask, vals, statuses = lax.fori_loop(
+        0, l_depth, step, (state, yes, tot, mask, vals, statuses0)
+    )
+
+    # Column-wise writeback via one-hot selects (no scatter in Pallas TPU).
+    scol = lax.broadcasted_iota(jnp.int32, (b, SCALAR_COLS), 1)
+    out = jnp.where(scol == _C_STATE, state[:, None], scal)
+    out = jnp.where(scol == _C_YES, yes[:, None], out)
+    out = jnp.where(scol == _C_TOT, tot[:, None], out)
+    out_scal_ref[...] = out
+    out_mask_ref[...] = mask
+    out_val_ref[...] = vals
+    out_status_ref[...] = statuses
+
+
+def pallas_ingest_body(
+    state, yes, tot, vote_mask, vote_val, n, req, cap, gossipsub, liveness,
+    slot_pack, grid_pack, *, block: int = 128, interpret: bool = False,
+):
+    """Drop-in alternative to :func:`hashgraph_tpu.ops.ingest.ingest_body`:
+    identical signature and outputs, with the vote scan running in the
+    Pallas kernel (gather/pack and unpack/scatter stay XLA and fuse around
+    the pallas_call)."""
+    s_count = slot_pack.shape[0]
+    slot_ids = slot_pack & ((1 << 30) - 1)
+    expired = (slot_pack >> 30) & 1
+
+    gather = lambda arr: jnp.take(arr, slot_ids, axis=0, mode="clip")
+    i32 = lambda arr: arr.astype(jnp.int32)
+    cols = [
+        i32(gather(state)),
+        i32(gather(yes)),
+        i32(gather(tot)),
+        i32(gather(n)),
+        i32(gather(req)),
+        i32(gather(cap)),
+        i32(gather(gossipsub)),
+        i32(gather(liveness)),
+        i32(expired),
+    ]
+    scal = jnp.zeros((s_count, SCALAR_COLS), jnp.int32)
+    for c, col in enumerate(cols):
+        scal = scal.at[:, c].set(col)
+    mask_rows = i32(gather(vote_mask))
+    val_rows = i32(gather(vote_val))
+
+    out_scal, out_mask, out_val, statuses = pallas_ingest_rows(
+        scal, mask_rows, val_rows, grid_pack, block=block, interpret=interpret
+    )
+
+    row_state = out_scal[:, _C_STATE]
+    scatter = lambda arr, rows: arr.at[slot_ids].set(
+        rows.astype(arr.dtype), mode="drop"
+    )
+    state = scatter(state, row_state)
+    yes = scatter(yes, out_scal[:, _C_YES])
+    tot = scatter(tot, out_scal[:, _C_TOT])
+    vote_mask = scatter(vote_mask, out_mask != 0)
+    vote_val = scatter(vote_val, out_val != 0)
+    out = jnp.concatenate([statuses, row_state[:, None]], axis=1).astype(jnp.int8)
+    return state, yes, tot, vote_mask, vote_val, out
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def pallas_ingest_rows(scal, mask, vals, grid, block: int = 128,
+                       interpret: bool = False):
+    """Run the VMEM-resident vote scan over gathered rows.
+
+    Args:
+      scal: int32[S, 16] packed per-row scalars (see column constants).
+      mask/vals: int32[S, V] voter masks/choices (0/1).
+      grid: int32[S, L] packed votes (lane | value<<16 | valid<<17).
+      block: rows per Pallas program (S must be a multiple, callers bucket).
+
+    Returns (scal', mask', vals', statuses int32[S, L]).
+    """
+    s_count, v_cap = mask.shape
+    l_depth = grid.shape[1]
+    block = min(block, s_count)  # pool buckets are powers of two
+    if s_count % block:
+        raise ValueError(f"S={s_count} not a multiple of block={block}")
+    grid_size = s_count // block
+
+    return pl.pallas_call(
+        _ingest_block_kernel,
+        grid=(grid_size,),
+        in_specs=[
+            pl.BlockSpec((block, SCALAR_COLS), lambda i: (i, 0)),
+            pl.BlockSpec((block, v_cap), lambda i: (i, 0)),
+            pl.BlockSpec((block, v_cap), lambda i: (i, 0)),
+            pl.BlockSpec((block, l_depth), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, SCALAR_COLS), lambda i: (i, 0)),
+            pl.BlockSpec((block, v_cap), lambda i: (i, 0)),
+            pl.BlockSpec((block, v_cap), lambda i: (i, 0)),
+            pl.BlockSpec((block, l_depth), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s_count, SCALAR_COLS), jnp.int32),
+            jax.ShapeDtypeStruct((s_count, v_cap), jnp.int32),
+            jax.ShapeDtypeStruct((s_count, v_cap), jnp.int32),
+            jax.ShapeDtypeStruct((s_count, l_depth), jnp.int32),
+        ],
+        interpret=interpret,
+    )(scal, mask, vals, grid)

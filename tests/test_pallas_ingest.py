@@ -1,0 +1,146 @@
+"""Parity: Pallas VMEM-resident vote scan vs the XLA lax.scan ingest path.
+
+Runs on CPU in interpreter mode (real lowering is exercised on TPU when the
+pool enables the Pallas path). Inputs map the pool arrays 1:1 onto rows so
+both kernels see identical state; outputs must match exactly.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hashgraph_tpu.ops.decide import STATE_ACTIVE, required_votes_np
+from hashgraph_tpu.ops.ingest import ingest_body, pack_grid, pack_slots
+from hashgraph_tpu.ops.pallas_ingest import (
+    SCALAR_COLS,
+    _C_CAP,
+    _C_EXPIRED,
+    _C_GOSSIP,
+    _C_LIVE,
+    _C_N,
+    _C_REQ,
+    _C_STATE,
+    _C_TOT,
+    _C_YES,
+    pallas_ingest_rows,
+)
+
+
+def build_case(seed, s_count=128, v_cap=16, l_depth=6):
+    rng = np.random.default_rng(seed)
+    n = rng.integers(1, v_cap + 1, s_count).astype(np.int32)
+    threshold = rng.choice([2 / 3, 0.5, 1.0])
+    req = required_votes_np(n, threshold).astype(np.int32)
+    gossip = rng.random(s_count) < 0.5
+    cap = np.where(gossip, 2, (2 * n.astype(np.int64) + 2) // 3).astype(np.int32)
+    live = rng.random(s_count) < 0.5
+    expired = rng.random(s_count) < 0.1
+    state = np.full(s_count, STATE_ACTIVE, np.int32)
+    yes = np.zeros(s_count, np.int32)
+    tot = np.zeros(s_count, np.int32)
+    # Pre-populate some sessions with an existing vote.
+    pre = rng.random(s_count) < 0.3
+    tot[pre] += 1
+    preyes = pre & (rng.random(s_count) < 0.5)
+    yes[preyes] += 1
+    mask = np.zeros((s_count, v_cap), np.int32)
+    vals = np.zeros((s_count, v_cap), np.int32)
+    mask[pre, 0] = 1
+    vals[preyes, 0] = 1
+
+    voter = rng.integers(0, v_cap, (s_count, l_depth)).astype(np.int32)
+    val = rng.random((s_count, l_depth)) < 0.5
+    valid = rng.random((s_count, l_depth)) < 0.9
+    grid = pack_grid(voter, val, valid)
+
+    scal = np.zeros((s_count, SCALAR_COLS), np.int32)
+    scal[:, _C_STATE] = state
+    scal[:, _C_YES] = yes
+    scal[:, _C_TOT] = tot
+    scal[:, _C_N] = n
+    scal[:, _C_REQ] = req
+    scal[:, _C_CAP] = cap
+    scal[:, _C_GOSSIP] = gossip
+    scal[:, _C_LIVE] = live
+    scal[:, _C_EXPIRED] = expired
+    return dict(
+        state=state, yes=yes, tot=tot, mask=mask, vals=vals,
+        n=n, req=req, cap=cap, gossip=gossip, live=live, expired=expired,
+        grid=grid, scal=scal,
+    )
+
+
+def test_pool_with_pallas_kernel_matches_default():
+    """Pool-level smoke: a pallas-backed pool behaves identically on a
+    mixed trace (interpret mode on CPU)."""
+    from hashgraph_tpu.engine.pool import ProposalPool
+
+    def run(use_pallas):
+        rng = np.random.default_rng(3)
+        pool = ProposalPool(16, 8, use_pallas=use_pallas)
+        pool.allocate_batch(
+            keys=[("s", i) for i in range(16)],
+            n=np.full(16, 5),
+            req=required_votes_np(np.full(16, 5), 2 / 3),
+            cap=np.where(np.arange(16) % 2 == 0, 2, 4),
+            gossip=(np.arange(16) % 2 == 0),
+            liveness=np.ones(16, bool),
+            expiry=np.full(16, 2_000_000_000),
+            created_at=np.full(16, 1_700_000_000),
+        )
+        out = []
+        for _ in range(3):
+            slots = rng.integers(0, 16, 40).astype(np.int64)
+            lanes = rng.integers(0, 8, 40).astype(np.int32)
+            values = rng.random(40) < 0.5
+            statuses, transitions = pool.ingest(slots, lanes, values, 1_700_000_000)
+            out.append((statuses.tolist(), transitions))
+        return out
+
+    assert run(False) == run(True)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_pallas_matches_xla_scan(seed):
+    case = build_case(seed)
+    s_count, v_cap = case["mask"].shape
+
+    # XLA path: pool arrays == rows (identity slot mapping).
+    slot_pack = pack_slots(
+        np.arange(s_count, dtype=np.int32), case["expired"]
+    )
+    xla_out = ingest_body(
+        jnp.asarray(case["state"]),
+        jnp.asarray(case["yes"]),
+        jnp.asarray(case["tot"]),
+        jnp.asarray(case["mask"] != 0),
+        jnp.asarray(case["vals"] != 0),
+        jnp.asarray(case["n"]),
+        jnp.asarray(case["req"]),
+        jnp.asarray(case["cap"]),
+        jnp.asarray(case["gossip"]),
+        jnp.asarray(case["live"]),
+        jnp.asarray(slot_pack),
+        jnp.asarray(case["grid"]),
+    )
+    x_state, x_yes, x_tot, x_mask, x_vals, x_out = map(np.asarray, xla_out)
+
+    p_scal, p_mask, p_vals, p_status = map(
+        np.asarray,
+        pallas_ingest_rows(
+            jnp.asarray(case["scal"]),
+            jnp.asarray(case["mask"]),
+            jnp.asarray(case["vals"]),
+            jnp.asarray(case["grid"]),
+            block=64,
+            interpret=True,
+        ),
+    )
+
+    np.testing.assert_array_equal(p_scal[:, _C_STATE], x_state)
+    np.testing.assert_array_equal(p_scal[:, _C_YES], x_yes)
+    np.testing.assert_array_equal(p_scal[:, _C_TOT], x_tot)
+    np.testing.assert_array_equal(p_mask != 0, x_mask)
+    np.testing.assert_array_equal(p_vals != 0, x_vals)
+    np.testing.assert_array_equal(p_status, x_out[:, :-1].astype(np.int32))
