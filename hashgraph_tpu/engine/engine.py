@@ -20,6 +20,8 @@ Division of labor:
 
 from __future__ import annotations
 
+import functools
+import threading
 from dataclasses import dataclass, field
 from typing import Generic, Hashable, TypeVar
 
@@ -133,6 +135,12 @@ class TpuConsensusEngine(Generic[Scope]):
             )
         self._max_sessions_per_scope = max_sessions_per_scope
         self.tracer = default_tracer
+        # One engine-wide reentrant lock: the reference service is fully
+        # thread-safe (whole-map RwLocks, src/storage.rs:192-193); the pool's
+        # host mirrors and free lists need the same discipline. Coarse
+        # locking is correct here — the device does the heavy lifting and
+        # host sections are short.
+        self._lock = threading.RLock()
 
         self._records: dict[int, SessionRecord[Scope]] = {}  # slot -> record
         self._index: dict[tuple[Scope, int], int] = {}  # (scope, pid) -> slot
@@ -808,3 +816,46 @@ class TpuConsensusEngine(Generic[Scope]):
 
     def _emit(self, scope: Scope, event: ConsensusEvent) -> None:
         self._event_bus.publish(scope, event)
+
+
+def _synchronized(fn):
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
+
+
+# Public API surface runs under the engine lock (reentrant: scalar entry
+# points funnel into ingest_votes). Event-bus publishes are non-blocking
+# (bounded queues, silent drop), so holding the lock across them is safe.
+for _name in (
+    "create_proposal",
+    "process_incoming_proposal",
+    "ingest_proposals",
+    "cast_vote",
+    "cast_vote_and_get_proposal",
+    "process_incoming_vote",
+    "ingest_votes",
+    "handle_consensus_timeout",
+    "sweep_timeouts",
+    "get_proposal",
+    "get_consensus_result",
+    "get_active_proposals",
+    "get_reached_proposals",
+    "get_scope_stats",
+    "export_session",
+    "save_to_storage",
+    "load_from_storage",
+    "delete_scope",
+    "set_scope_config",
+    "get_scope_config",
+    "_initialize_scope",
+    "_update_scope_config",
+):
+    setattr(
+        TpuConsensusEngine,
+        _name,
+        _synchronized(getattr(TpuConsensusEngine, _name)),
+    )

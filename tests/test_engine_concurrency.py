@@ -1,0 +1,117 @@
+"""Engine thread-safety: parallel voters, same-voter races, mixed ops.
+
+Mirrors the reference's concurrency suite (tests/concurrency_tests.rs) on
+the TPU engine: N threads hammer the same engine; outcomes must equal the
+sequential semantics (exactly one success per race, consistent final state).
+"""
+
+import threading
+
+import pytest
+
+from hashgraph_tpu import (
+    ConsensusError,
+    CreateProposalRequest,
+    DuplicateVote,
+    StatusCode,
+    UserAlreadyVoted,
+    build_vote,
+)
+from hashgraph_tpu.engine import TpuConsensusEngine
+
+from common import NOW, random_stub_signer
+
+
+def request(n, name="p", exp=1000):
+    return CreateProposalRequest(
+        name=name,
+        payload=b"",
+        proposal_owner=b"o",
+        expected_voters_count=n,
+        expiration_timestamp=exp,
+        liveness_criteria_yes=True,
+    )
+
+
+class TestEngineConcurrency:
+    def test_parallel_distinct_voters_all_succeed(self):
+        engine = TpuConsensusEngine(
+            random_stub_signer(), capacity=8, voter_capacity=16
+        )
+        # Threshold 1.0 so all 10 votes land before a decision cuts them off.
+        engine.scope("s").with_threshold(1.0).initialize()
+        pid = engine.create_proposal("s", request(10), NOW).proposal_id
+        base = engine.get_proposal("s", pid)
+        votes = [
+            build_vote(base, True, random_stub_signer(), NOW) for _ in range(10)
+        ]
+        barrier = threading.Barrier(10)
+        results = []
+        lock = threading.Lock()
+
+        def worker(vote):
+            barrier.wait()
+            st = engine.ingest_votes([("s", vote)], NOW, pre_validated=True)
+            with lock:
+                results.append(int(st[0]))
+
+        threads = [threading.Thread(target=worker, args=(v,)) for v in votes]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results.count(int(StatusCode.OK)) == 10
+        assert engine.export_session("s", pid).proposal.round == 2
+
+    def test_same_voter_race_single_success(self):
+        engine = TpuConsensusEngine(
+            random_stub_signer(), capacity=8, voter_capacity=16
+        )
+        pid = engine.create_proposal("s", request(10), NOW).proposal_id
+        voter = random_stub_signer()
+        base = engine.get_proposal("s", pid)
+        vote = build_vote(base, True, voter, NOW)
+        barrier = threading.Barrier(5)
+        outcomes = []
+        lock = threading.Lock()
+
+        def worker():
+            barrier.wait()
+            st = engine.ingest_votes([("s", vote.clone())], NOW, pre_validated=True)
+            with lock:
+                outcomes.append(int(st[0]))
+
+        threads = [threading.Thread(target=worker) for _ in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert outcomes.count(int(StatusCode.OK)) == 1
+        assert outcomes.count(int(StatusCode.DUPLICATE_VOTE)) == 4
+
+    def test_parallel_proposal_creation(self):
+        engine = TpuConsensusEngine(
+            random_stub_signer(), capacity=64, voter_capacity=8,
+            max_sessions_per_scope=64,
+        )
+        barrier = threading.Barrier(8)
+        pids = []
+        lock = threading.Lock()
+
+        def worker(i):
+            barrier.wait()
+            p = engine.create_proposal(f"scope{i % 2}", request(3, f"p{i}"), NOW + i)
+            with lock:
+                pids.append(p.proposal_id)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(pids)) == 8
+        total = (
+            engine.get_scope_stats("scope0").total_sessions
+            + engine.get_scope_stats("scope1").total_sessions
+        )
+        assert total == 8
