@@ -8,9 +8,11 @@ reference's scalar API is a thin veneer over the batch path (:mod:`.engine`).
 
 from .engine import SessionRecord, TpuConsensusEngine
 from .pool import PoolFullError, ProposalPool, SlotMeta
+from .storage import TpuBackedStorage
 
 __all__ = [
     "TpuConsensusEngine",
+    "TpuBackedStorage",
     "SessionRecord",
     "ProposalPool",
     "SlotMeta",

@@ -43,7 +43,6 @@ from ..ops.decide import (
     STATE_FAILED,
     STATE_REACHED_NO,
     STATE_REACHED_YES,
-    required_votes_np,
 )
 from ..protocol import build_vote, validate_proposal_timestamp, validate_vote
 from ..scope_config import ScopeConfig, ScopeConfigBuilder
@@ -59,6 +58,7 @@ from ..types import (
 )
 from ..wire import Proposal, Vote
 from .pool import ProposalPool
+from .session_sync import allocate_slot, load_session_rows
 
 Scope = TypeVar("Scope", bound=Hashable)
 
@@ -308,18 +308,9 @@ class TpuConsensusEngine(Generic[Scope]):
         config: ConsensusConfig,
         now: int,
     ) -> SessionRecord[Scope]:
-        n = proposal.expected_voters_count
-        threshold = config.consensus_threshold
-        slot = self._pool.allocate_batch(
-            keys=[(scope, proposal.proposal_id)],
-            n=np.array([n]),
-            req=required_votes_np(np.array([n]), threshold),
-            cap=np.array([config.max_round_limit(n)]),
-            gossip=np.array([config.use_gossipsub_rounds]),
-            liveness=np.array([proposal.liveness_criteria_yes]),
-            expiry=np.array([proposal.expiration_timestamp]),
-            created_at=np.array([now]),
-        )[0]
+        slot = allocate_slot(
+            self._pool, (scope, proposal.proposal_id), proposal, config, now
+        )
         record = SessionRecord(
             scope=scope,
             slot=slot,
@@ -351,29 +342,8 @@ class TpuConsensusEngine(Generic[Scope]):
             return  # evicted immediately by the per-scope cap (created_at tie)
         record.votes = {k: v.clone() for k, v in session.votes.items()}
         if session.votes or not session.state.is_active:
-            meta = self._pool.meta(record.slot)
-            vcap = self._pool.voter_capacity
-            mask = np.zeros((1, vcap), bool)
-            vals = np.zeros((1, vcap), bool)
-            for owner, vote in session.votes.items():
-                lane = meta.lane_for(owner, vcap)
-                mask[0, lane] = True
-                vals[0, lane] = vote.vote
-            state = {
-                True: STATE_REACHED_YES,
-                False: STATE_REACHED_NO,
-            }[session.state.result] if session.state.is_reached else (
-                STATE_FAILED if session.state.is_failed else STATE_ACTIVE
-            )
-            yes = sum(1 for v in session.votes.values() if v.vote)
-            self._pool.load_rows(
-                [record.slot],
-                state=np.array([state]),
-                yes=np.array([yes]),
-                tot=np.array([len(session.votes)]),
-                mask_rows=mask,
-                val_rows=vals,
-            )
+            loaded = load_session_rows(self._pool, record.slot, session)
+            assert loaded  # capacity pre-checked above
 
     # ── Voting ─────────────────────────────────────────────────────────
 

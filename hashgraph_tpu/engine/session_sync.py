@@ -1,0 +1,93 @@
+"""Shared scalar-session ↔ dense-row conversion for pool clients.
+
+Both the batch engine (loading validated network proposals / restored
+checkpoints) and the TPU-backed storage (reconciling after scalar mutations)
+must project a ConsensusSession onto a pool slot identically — same
+threshold math, same round caps, same lane assignment. One implementation,
+two callers.
+"""
+
+from __future__ import annotations
+
+from typing import Hashable
+
+import numpy as np
+
+from ..ops.decide import (
+    STATE_ACTIVE,
+    STATE_FAILED,
+    STATE_REACHED_NO,
+    STATE_REACHED_YES,
+    required_votes_np,
+)
+from ..session import ConsensusConfig, ConsensusSession, ConsensusState
+from ..wire import Proposal
+from .pool import ProposalPool
+
+__all__ = [
+    "allocate_slot",
+    "load_session_rows",
+    "state_code_of",
+]
+
+
+def state_code_of(state: ConsensusState) -> int:
+    if state.is_reached:
+        return STATE_REACHED_YES if state.result else STATE_REACHED_NO
+    return STATE_FAILED if state.is_failed else STATE_ACTIVE
+
+
+def allocate_slot(
+    pool: ProposalPool,
+    key: Hashable,
+    proposal: Proposal,
+    config: ConsensusConfig,
+    created_at: int,
+) -> int:
+    """Claim and configure one slot for a proposal (exact integer threshold
+    math, reference: src/utils.rs:307-313). Raises PoolFullError/ValueError
+    like allocate_batch."""
+    n = proposal.expected_voters_count
+    return pool.allocate_batch(
+        keys=[key],
+        n=np.array([n]),
+        req=required_votes_np(np.array([n]), config.consensus_threshold),
+        cap=np.array([config.max_round_limit(n)]),
+        gossip=np.array([config.use_gossipsub_rounds]),
+        liveness=np.array([proposal.liveness_criteria_yes]),
+        expiry=np.array([proposal.expiration_timestamp]),
+        created_at=np.array([created_at]),
+    )[0]
+
+
+def load_session_rows(
+    pool: ProposalPool, slot: int, session: ConsensusSession
+) -> bool:
+    """Write a session's tallies/masks/lifecycle into an allocated slot.
+
+    Returns False (without loading) when the session's distinct voters
+    exceed the pool's lane capacity — the caller decides whether that is an
+    error (engine: reject the proposal) or a degrade-to-host condition
+    (storage: release the slot)."""
+    vcap = pool.voter_capacity
+    if len(session.votes) > vcap:
+        return False
+    meta = pool.meta(slot)
+    mask = np.zeros((1, vcap), bool)
+    vals = np.zeros((1, vcap), bool)
+    for owner, vote in session.votes.items():
+        lane = meta.lane_for(owner, vcap)
+        if lane is None:
+            return False
+        mask[0, lane] = True
+        vals[0, lane] = vote.vote
+    yes = sum(1 for v in session.votes.values() if v.vote)
+    pool.load_rows(
+        [slot],
+        state=np.array([state_code_of(session.state)]),
+        yes=np.array([yes]),
+        tot=np.array([len(session.votes)]),
+        mask_rows=mask,
+        val_rows=vals,
+    )
+    return True

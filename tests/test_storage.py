@@ -1,6 +1,10 @@
 """Storage-contract tests (reference: tests/storage_stream_tests.rs):
 stream/list/remove/replace, update error paths, empty-scope cleanup, and
-scope-config validation paths."""
+scope-config validation paths.
+
+Parametrized over every ConsensusStorage implementation — the in-memory
+default and the device-pool-backed TpuBackedStorage must satisfy the same
+contract."""
 
 import pytest
 
@@ -23,6 +27,18 @@ from common import NOW, make_service, random_stub_signer
 SCOPE = "storage_scope"
 
 
+def _tpu_backed():
+    from hashgraph_tpu.engine import TpuBackedStorage
+
+    return TpuBackedStorage(capacity=32, voter_capacity=8)
+
+
+@pytest.fixture(params=["in_memory", "tpu_backed"])
+def make_storage(request):
+    """Storage factory, parametrized over every backend."""
+    return InMemoryConsensusStorage if request.param == "in_memory" else _tpu_backed
+
+
 def make_session(n=3, now=NOW) -> ConsensusSession:
     request = CreateProposalRequest(
         name="S",
@@ -37,8 +53,8 @@ def make_session(n=3, now=NOW) -> ConsensusSession:
 
 
 class TestSessionPrimitives:
-    def test_save_get_remove(self):
-        storage = InMemoryConsensusStorage()
+    def test_save_get_remove(self, make_storage):
+        storage = make_storage()
         session = make_session()
         pid = session.proposal.proposal_id
         storage.save_session(SCOPE, session)
@@ -49,8 +65,8 @@ class TestSessionPrimitives:
         assert storage.remove_session(SCOPE, pid) is None
         assert storage.remove_session("ghost", 1) is None
 
-    def test_get_returns_snapshot_not_alias(self):
-        storage = InMemoryConsensusStorage()
+    def test_get_returns_snapshot_not_alias(self, make_storage):
+        storage = make_storage()
         session = make_session()
         pid = session.proposal.proposal_id
         storage.save_session(SCOPE, session)
@@ -58,9 +74,9 @@ class TestSessionPrimitives:
         snapshot.proposal.name = "mutated"
         assert storage.get_session(SCOPE, pid).proposal.name == "S"
 
-    def test_list_and_stream(self):
+    def test_list_and_stream(self, make_storage):
         """reference: tests/storage_stream_tests.rs:42-127"""
-        storage = InMemoryConsensusStorage()
+        storage = make_storage()
         assert storage.list_scope_sessions(SCOPE) is None
         sessions = [make_session() for _ in range(3)]
         for s in sessions:
@@ -73,8 +89,8 @@ class TestSessionPrimitives:
         assert len(streamed) == 3
         assert list(storage.stream_scope_sessions("ghost")) == []
 
-    def test_replace_scope_sessions(self):
-        storage = InMemoryConsensusStorage()
+    def test_replace_scope_sessions(self, make_storage):
+        storage = make_storage()
         storage.save_session(SCOPE, make_session())
         replacement = [make_session(), make_session()]
         storage.replace_scope_sessions(SCOPE, replacement)
@@ -83,23 +99,23 @@ class TestSessionPrimitives:
             s.proposal.proposal_id for s in replacement
         }
 
-    def test_list_scopes(self):
-        storage = InMemoryConsensusStorage()
+    def test_list_scopes(self, make_storage):
+        storage = make_storage()
         assert storage.list_scopes() is None
         storage.save_session("a", make_session())
         storage.save_session("b", make_session())
         assert set(storage.list_scopes()) == {"a", "b"}
 
-    def test_update_session_not_found(self):
+    def test_update_session_not_found(self, make_storage):
         """reference: tests/storage_stream_tests.rs:130-181"""
-        storage = InMemoryConsensusStorage()
+        storage = make_storage()
         with pytest.raises(SessionNotFound):
             storage.update_session(SCOPE, 42, lambda s: None)
 
-    def test_update_session_mutation_persists_even_on_error(self):
+    def test_update_session_mutation_persists_even_on_error(self, make_storage):
         # Mirrors the reference: the mutator runs on the stored value, so
         # state changes made before an error stick (Failed-on-cap semantics).
-        storage = InMemoryConsensusStorage()
+        storage = make_storage()
         session = make_session()
         pid = session.proposal.proposal_id
         storage.save_session(SCOPE, session)
@@ -112,8 +128,8 @@ class TestSessionPrimitives:
             storage.update_session(SCOPE, pid, mutator)
         assert storage.get_session(SCOPE, pid).proposal.name == "touched"
 
-    def test_update_scope_sessions_empty_removes_scope(self):
-        storage = InMemoryConsensusStorage()
+    def test_update_scope_sessions_empty_removes_scope(self, make_storage):
+        storage = make_storage()
         storage.save_session(SCOPE, make_session())
 
         storage.update_scope_sessions(SCOPE, lambda sessions: sessions.clear())
@@ -121,11 +137,76 @@ class TestSessionPrimitives:
         assert storage.list_scopes() is None
 
 
+class TestBackendEquivalenceEdges:
+    """Regression: corner semantics where backends could diverge."""
+
+    def test_update_scope_sessions_creates_scope_from_append(self, make_storage):
+        storage = make_storage()
+        session = make_session()
+        storage.update_scope_sessions("fresh", lambda l: l.append(session))
+        listed = storage.list_scope_sessions("fresh")
+        assert listed is not None and len(listed) == 1
+
+    def test_remove_last_session_keeps_empty_scope(self, make_storage):
+        storage = make_storage()
+        session = make_session()
+        storage.save_session(SCOPE, session)
+        storage.remove_session(SCOPE, session.proposal.proposal_id)
+        assert storage.list_scope_sessions(SCOPE) == []
+
+    def test_replace_with_empty_keeps_scope(self, make_storage):
+        storage = make_storage()
+        storage.save_session(SCOPE, make_session())
+        storage.replace_scope_sessions(SCOPE, [])
+        assert storage.list_scope_sessions(SCOPE) == []
+
+    def test_save_overwrite_same_id_refreshes_everything(self, make_storage):
+        storage = make_storage()
+        first = make_session(n=3)
+        pid = first.proposal.proposal_id
+        storage.save_session(SCOPE, first)
+        second = make_session(n=5)
+        second.proposal.proposal_id = pid
+        storage.save_session(SCOPE, second)
+        stored = storage.get_session(SCOPE, pid)
+        assert stored.proposal.expected_voters_count == 5
+        # Device replica (when present) reflects the new session, not stale
+        # config from the first save.
+        if hasattr(storage, "device_state_of"):
+            from hashgraph_tpu.ops import STATE_ACTIVE
+
+            assert storage.device_state_of(SCOPE, pid) == STATE_ACTIVE
+            slot = storage._slots[(SCOPE, pid)]
+            assert int(storage.pool()._n[slot]) == 5
+
+    def test_oversized_session_degrades_to_host_only(self):
+        from hashgraph_tpu.engine import TpuBackedStorage
+
+        storage = TpuBackedStorage(capacity=8, voter_capacity=4)
+        big = make_session(n=3)
+        pid = big.proposal.proposal_id
+        storage.save_session(SCOPE, big)
+        assert storage.device_state_of(SCOPE, pid) is not None
+
+        # Mutate in more distinct voters than the pool has lanes: the
+        # session stays queryable (host truth) with no stale device row.
+        from hashgraph_tpu.wire import Vote
+
+        def add_voters(s):
+            for i in range(6):
+                owner = bytes([50 + i]) * 4
+                s.votes[owner] = Vote(vote_owner=owner, vote=True)
+
+        storage.update_session(SCOPE, pid, add_voters)
+        assert len(storage.get_session(SCOPE, pid).votes) == 6
+        assert storage.device_state_of(SCOPE, pid) is None
+
+
 class TestScopeConfigStorage:
     """reference: tests/storage_stream_tests.rs:184-244"""
 
-    def test_get_set_roundtrip(self):
-        storage = InMemoryConsensusStorage()
+    def test_get_set_roundtrip(self, make_storage):
+        storage = make_storage()
         assert storage.get_scope_config(SCOPE) is None
         config = ScopeConfig(network_type=NetworkType.P2P, default_consensus_threshold=0.8)
         storage.set_scope_config(SCOPE, config)
@@ -136,15 +217,15 @@ class TestScopeConfigStorage:
         loaded.default_consensus_threshold = 0.1
         assert storage.get_scope_config(SCOPE).default_consensus_threshold == 0.8
 
-    def test_set_invalid_config_rejected(self):
-        storage = InMemoryConsensusStorage()
+    def test_set_invalid_config_rejected(self, make_storage):
+        storage = make_storage()
         bad = ScopeConfig(default_consensus_threshold=1.5)
         with pytest.raises(InvalidConsensusThreshold):
             storage.set_scope_config(SCOPE, bad)
         assert storage.get_scope_config(SCOPE) is None
 
-    def test_update_creates_default_then_validates(self):
-        storage = InMemoryConsensusStorage()
+    def test_update_creates_default_then_validates(self, make_storage):
+        storage = make_storage()
 
         def updater(config):
             config.default_consensus_threshold = 0.9
@@ -158,8 +239,8 @@ class TestScopeConfigStorage:
         with pytest.raises(InvalidMaxRounds):
             storage.update_scope_config(SCOPE, bad_updater)
 
-    def test_delete_scope_clears_config_and_sessions(self):
-        storage = InMemoryConsensusStorage()
+    def test_delete_scope_clears_config_and_sessions(self, make_storage):
+        storage = make_storage()
         storage.save_session(SCOPE, make_session())
         storage.set_scope_config(SCOPE, ScopeConfig())
         storage.delete_scope(SCOPE)
@@ -172,7 +253,7 @@ class TestCustomStorageBackend:
     satisfying the contract works end-to-end (role analogous to
     reference: tests/custom_scheme_tests.rs for the signer axis)."""
 
-    def test_service_over_custom_storage(self):
+    def test_service_over_custom_storage(self, make_storage):
         class TracingStorage(InMemoryConsensusStorage):
             def __init__(self):
                 super().__init__()

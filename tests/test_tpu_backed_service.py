@@ -1,0 +1,126 @@
+"""The north-star integration: an unchanged ConsensusService running on
+TpuBackedStorage — session state resident in the device pool, identical
+observable behavior, device replica tracking every transition."""
+
+import pytest
+
+from hashgraph_tpu import (
+    BroadcastEventBus,
+    ConsensusReached,
+    ConsensusService,
+    CreateProposalRequest,
+    InsufficientVotesAtTimeout,
+    NetworkType,
+    build_vote,
+)
+from hashgraph_tpu.engine import TpuBackedStorage
+from hashgraph_tpu.ops import (
+    STATE_ACTIVE,
+    STATE_FAILED,
+    STATE_REACHED_NO,
+    STATE_REACHED_YES,
+)
+
+from common import NOW, random_stub_signer
+
+
+def make_tpu_service():
+    storage = TpuBackedStorage(capacity=32, voter_capacity=8)
+    service = ConsensusService(storage, BroadcastEventBus(), random_stub_signer())
+    return service, storage
+
+
+def request(n=3, exp=100, liveness=True, name="p"):
+    return CreateProposalRequest(
+        name=name,
+        payload=b"",
+        proposal_owner=b"o",
+        expected_voters_count=n,
+        expiration_timestamp=exp,
+        liveness_criteria_yes=liveness,
+    )
+
+
+class TestServiceOnTpuStorage:
+    def test_quickstart_flow_with_device_tracking(self):
+        service, storage = make_tpu_service()
+        receiver = service.event_bus().subscribe()
+        pid = service.create_proposal("s", request(3), NOW).proposal_id
+        assert storage.device_state_of("s", pid) == STATE_ACTIVE
+
+        service.cast_vote("s", pid, True, NOW)
+        assert storage.device_state_of("s", pid) == STATE_ACTIVE
+
+        vote = build_vote(
+            storage.get_proposal("s", pid), True, random_stub_signer(), NOW
+        )
+        service.process_incoming_vote("s", vote, NOW)
+
+        # Scalar truth and device replica agree.
+        assert storage.get_consensus_result("s", pid) is True
+        assert storage.device_state_of("s", pid) == STATE_REACHED_YES
+        scope, event = receiver.recv(timeout=1)
+        assert event == ConsensusReached(pid, True, NOW)
+
+    def test_timeout_paths_track_on_device(self):
+        service, storage = make_tpu_service()
+        # liveness YES fill -> decided at timeout.
+        pid_yes = service.create_proposal("s", request(5, liveness=True), NOW).proposal_id
+        service.cast_vote("s", pid_yes, True, NOW)
+        assert service.handle_consensus_timeout("s", pid_yes, NOW + 200) is True
+        assert storage.device_state_of("s", pid_yes) == STATE_REACHED_YES
+
+        # Tie at threshold 1.0 -> Failed.
+        service.scope("t").with_threshold(1.0).initialize()
+        pid_fail = service.create_proposal("t", request(4, liveness=True), NOW).proposal_id
+        for i, signer in enumerate([random_stub_signer(), random_stub_signer()]):
+            vote = build_vote(
+                storage.get_proposal("t", pid_fail), i % 2 == 0, signer, NOW
+            )
+            service.process_incoming_vote("t", vote, NOW)
+        with pytest.raises(InsufficientVotesAtTimeout):
+            service.handle_consensus_timeout("t", pid_fail, NOW + 200)
+        assert storage.device_state_of("t", pid_fail) == STATE_FAILED
+
+    def test_p2p_round_cap_tracks_failed(self):
+        service, storage = make_tpu_service()
+        service.scope("s").with_network_type(NetworkType.P2P).initialize()
+        # liveness=False and a Y,N,Y spread keep the session undecided
+        # through the cap: yes_w=2 < req=3, no_w=1+1 silent=2, no tie.
+        pid = service.create_proposal(
+            "s", request(4, liveness=False), NOW
+        ).proposal_id
+        # P2P cap = ceil(2*4/3) = 3 votes; the 4th errors and fails the session.
+        from hashgraph_tpu import MaxRoundsExceeded
+
+        voters = [random_stub_signer() for _ in range(4)]
+        for voter, choice in zip(voters[:3], [True, False, True]):
+            vote = build_vote(storage.get_proposal("s", pid), choice, voter, NOW)
+            service.process_incoming_vote("s", vote, NOW)
+        vote = build_vote(storage.get_proposal("s", pid), True, voters[3], NOW)
+        with pytest.raises(MaxRoundsExceeded):
+            service.process_incoming_vote("s", vote, NOW)
+        assert storage.device_state_of("s", pid) == STATE_FAILED
+
+    def test_eviction_releases_pool_slots(self):
+        storage = TpuBackedStorage(capacity=8, voter_capacity=8)
+        service = ConsensusService(
+            storage, BroadcastEventBus(), random_stub_signer(),
+            max_sessions_per_scope=2,
+        )
+        for i in range(5):
+            service.create_proposal("s", request(3, name=f"p{i}"), NOW + i)
+        assert len(storage.list_scope_sessions("s")) == 2
+        assert storage.pool().allocated_slots == 2
+
+    def test_shared_pool_with_engine_view(self):
+        """Storage and batch engine can share one device pool."""
+        from hashgraph_tpu.engine import ProposalPool
+
+        pool = ProposalPool(16, 8)
+        storage = TpuBackedStorage(pool=pool)
+        service = ConsensusService(storage, BroadcastEventBus(), random_stub_signer())
+        pid = service.create_proposal("s", request(3), NOW).proposal_id
+        assert pool.allocated_slots == 1
+        service.cast_vote("s", pid, True, NOW)
+        assert storage.device_state_of("s", pid) == STATE_ACTIVE
