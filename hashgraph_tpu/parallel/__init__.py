@@ -7,6 +7,18 @@ axis. Collectives (psum over ICI) appear only in global aggregation.
 """
 
 from .mesh import PROPOSAL_AXIS, consensus_mesh
+from .multihost import (
+    distributed_consensus_mesh,
+    initialize_distributed,
+    local_slot_range,
+)
 from .sharded import ShardedPool
 
-__all__ = ["consensus_mesh", "ShardedPool", "PROPOSAL_AXIS"]
+__all__ = [
+    "consensus_mesh",
+    "ShardedPool",
+    "PROPOSAL_AXIS",
+    "initialize_distributed",
+    "distributed_consensus_mesh",
+    "local_slot_range",
+]

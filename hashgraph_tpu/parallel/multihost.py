@@ -1,0 +1,81 @@
+"""Multi-host scaffolding: process initialization + the scale-out design.
+
+Single-host multi-device is fully implemented (ShardedPool over a mesh,
+validated on virtual 8-device meshes and the driver's multi-chip dry run).
+This module holds the multi-host entry point and documents how the design
+extends — it is scaffolding in the honest sense: initialization and mesh
+construction work on any jax.distributed deployment, while the per-process
+data-feeding path below is exercised only single-host in this repo.
+
+Scale-out design (the scaling-book recipe applied to consensus):
+
+- **Slot ownership follows device ownership.** The global pool's slot axis
+  shards over the full mesh; each process owns the contiguous slot ranges of
+  its addressable devices. The host-side router (`ShardedPool._route`)
+  already computes per-device sections — multi-host, each process simply
+  materializes only its own sections (`jax.make_array_from_process_local_data`)
+  instead of the full batch.
+- **Vote traffic is DCN-free by construction.** The embedder's transport
+  (gossip) delivers votes to whichever host received them; a thin
+  shard-aware relay forwards each vote to the process owning its proposal's
+  slot — consensus state itself never crosses DCN. The only collective,
+  the psum in `global_state_counts`, rides ICI within a slice and DCN
+  across slices, and it is O(#states) per sweep.
+- **Signatures verify where votes arrive** (host CPU, native runtime), so
+  adding hosts scales verification linearly with the fleet, independent of
+  the TPU topology.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .mesh import PROPOSAL_AXIS, consensus_mesh
+
+__all__ = ["initialize_distributed", "distributed_consensus_mesh"]
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Bring up jax.distributed for a multi-host deployment.
+
+    On TPU pods the arguments auto-detect from the environment; pass them
+    explicitly elsewhere. Call once per process before any jax computation.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def distributed_consensus_mesh(axis_name: str = PROPOSAL_AXIS):
+    """The 1-D consensus mesh spanning every device of every process."""
+    return consensus_mesh(axis_name=axis_name)
+
+
+def local_slot_range(
+    capacity_per_device: int, mesh=None
+) -> tuple[int, int]:
+    """The global slot interval owned by this process: [start, stop).
+
+    With slots laid out contiguously per device in mesh order, a process
+    owns the union of its addressable devices' ranges (contiguous on
+    standard TPU topologies where local devices are consecutive in the
+    mesh).
+    """
+    mesh = mesh if mesh is not None else distributed_consensus_mesh()
+    devices = list(mesh.devices.flat)
+    local = [i for i, d in enumerate(devices) if d.process_index == jax.process_index()]
+    if not local:
+        return (0, 0)
+    start, stop = min(local), max(local) + 1
+    if local != list(range(start, stop)):
+        raise RuntimeError(
+            "this process's devices are not contiguous in the mesh; "
+            "reorder the mesh so slot ranges stay process-local"
+        )
+    return (start * capacity_per_device, stop * capacity_per_device)
