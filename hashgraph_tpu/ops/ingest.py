@@ -95,16 +95,22 @@ def group_batch(slot_idx: np.ndarray):
         return np.empty(0, np.int64), np.empty(0, np.int64), np.empty(0, np.int64), 0
     order = np.argsort(slot_idx, kind="stable")
     sorted_slots = slot_idx[order]
-    uniq, inverse_sorted, counts = np.unique(
-        sorted_slots, return_inverse=True, return_counts=True
-    )
-    starts = np.cumsum(counts) - counts
-    pos_sorted = np.arange(b_count) - starts[inverse_sorted]
+    # Group boundaries straight from the sorted run (np.unique would sort a
+    # second time — this path sits on the ingest hot loop).
+    is_start = np.empty(b_count, bool)
+    is_start[0] = True
+    np.not_equal(sorted_slots[1:], sorted_slots[:-1], out=is_start[1:])
+    starts_idx = np.nonzero(is_start)[0]
+    uniq = sorted_slots[starts_idx]
+    inverse_sorted = np.cumsum(is_start) - 1
+    starts = starts_idx[inverse_sorted]
+    pos_sorted = np.arange(b_count) - starts
+    counts_max = int(np.max(np.diff(np.append(starts_idx, b_count))))
     row = np.empty(b_count, dtype=np.int64)
     col = np.empty(b_count, dtype=np.int64)
     row[order] = inverse_sorted
     col[order] = pos_sorted
-    return uniq, row, col, int(counts.max())
+    return uniq, row, col, counts_max
 
 
 _OK = int(StatusCode.OK)

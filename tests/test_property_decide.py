@@ -1,0 +1,71 @@
+"""Property-based fuzz: the device decision kernel vs the scalar oracle.
+
+The decision math is the bit-exactness heart of the framework (SURVEY §2.1:
+n≤2 unanimity, quorum gate, silent weighting, strict majority, tie-break,
+and the f64-epsilon 2/3 special case). Hypothesis explores the input space
+far beyond the transcribed reference tables.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import jax.numpy as jnp
+
+from hashgraph_tpu.protocol import (
+    calculate_threshold_based_value,
+    decide as scalar_decide,
+)
+from hashgraph_tpu.ops.decide import decide_kernel, required_votes_np
+
+thresholds = st.one_of(
+    st.just(2.0 / 3.0),
+    st.floats(min_value=0.0, max_value=1.0, allow_nan=False),
+    # Values epsilon-close to 2/3 probe the div_ceil special case boundary.
+    st.builds(
+        lambda ulps: float(np.nextafter(2.0 / 3.0, 1.0 if ulps > 0 else 0.0))
+        if ulps
+        else 2.0 / 3.0,
+        st.integers(min_value=-1, max_value=1),
+    ),
+)
+
+
+@settings(max_examples=300, deadline=None)
+@given(
+    n=st.integers(min_value=1, max_value=2000),
+    threshold=thresholds,
+)
+def test_required_votes_matches_scalar(n, threshold):
+    scalar = calculate_threshold_based_value(n, threshold)
+    vectorized = int(required_votes_np(np.array([n]), threshold)[0])
+    assert scalar == vectorized
+
+
+@settings(max_examples=500, deadline=None)
+@given(
+    n=st.integers(min_value=1, max_value=300),
+    data=st.data(),
+    threshold=thresholds,
+    liveness=st.booleans(),
+    is_timeout=st.booleans(),
+)
+def test_decide_kernel_matches_scalar(n, data, threshold, liveness, is_timeout):
+    total = data.draw(st.integers(min_value=0, max_value=n + 5))
+    yes = data.draw(st.integers(min_value=0, max_value=total))
+
+    expected = scalar_decide(yes, total, n, threshold, liveness, is_timeout)
+
+    req = required_votes_np(np.array([n]), threshold)
+    decided, result = decide_kernel(
+        jnp.array([yes], jnp.int32),
+        jnp.array([total], jnp.int32),
+        jnp.array([n], jnp.int32),
+        jnp.asarray(req, jnp.int32),
+        jnp.array([liveness]),
+        jnp.array([is_timeout]),
+    )
+    got = bool(result[0]) if bool(decided[0]) else None
+    assert got == expected, (
+        f"n={n} yes={yes} total={total} threshold={threshold!r} "
+        f"liveness={liveness} timeout={is_timeout}: scalar={expected} device={got}"
+    )
