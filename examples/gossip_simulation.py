@@ -1,0 +1,75 @@
+"""Multi-peer gossip simulation: independent services, out-of-order delivery.
+
+Five fully separate peers (own storage, own bus, own keys) exchange wire
+bytes only — the pattern a real gossip transport implements
+(reference: tests/network_gossip_tests.rs). Run: python examples/gossip_simulation.py
+"""
+
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from hashgraph_tpu import (
+    ConsensusService,
+    CreateProposalRequest,
+    StubConsensusSigner,
+    Proposal,
+    Vote,
+)
+
+N_PEERS = 5
+
+
+def main() -> None:
+    rng = random.Random(42)
+    peers = [
+        ConsensusService.default_service(StubConsensusSigner(bytes([i + 1]) * 20))
+        for i in range(N_PEERS)
+    ]
+    now = int(time.time())
+    scope = "network"
+
+    # Peer 0 creates and broadcasts the proposal as wire bytes.
+    proposal = peers[0].create_proposal(
+        scope,
+        CreateProposalRequest(
+            name="elect-coordinator", payload=b"", proposal_owner=b"p0",
+            expected_voters_count=N_PEERS, expiration_timestamp=60,
+            liveness_criteria_yes=False,
+        ),
+        now,
+    )
+    wire = proposal.encode()
+    for peer in peers[1:]:
+        peer.process_incoming_proposal(scope, Proposal.decode(wire), now)
+    print(f"proposal {proposal.proposal_id} delivered to {N_PEERS} peers")
+
+    # Everyone votes (peer 1 dissents -> 4 YES of 5, quorum is ceil(10/3)=4);
+    # votes gossip to all peers in RANDOM order.
+    mailbox: list[bytes] = []
+    for i, peer in enumerate(peers):
+        vote = peer.cast_vote(scope, proposal.proposal_id, i != 1, now)
+        mailbox.append(vote.encode())
+    rng.shuffle(mailbox)
+
+    for raw in mailbox:
+        vote = Vote.decode(raw)
+        for i, peer in enumerate(peers):
+            if peer.signer().identity() == vote.vote_owner:
+                continue  # own vote already applied locally
+            peer.process_incoming_vote(scope, vote.clone(), now)
+
+    # All peers converge on the same result.
+    results = [
+        peer.storage().get_consensus_result(scope, proposal.proposal_id)
+        for peer in peers
+    ]
+    print("per-peer results:", results)
+    assert len(set(results)) == 1, "peers diverged!"
+    print(f"converged: consensus = {results[0]} (4 YES of {N_PEERS})")
+
+
+if __name__ == "__main__":
+    main()
