@@ -99,6 +99,19 @@ class ConsensusService(Generic[Scope]):
             max_sessions_per_scope,
         )
 
+    @classmethod
+    def new(cls, signer: ConsensusSignatureScheme) -> "ConsensusService":
+        """Default-backends ctor under the reference's name
+        (reference: src/service.rs:86-91)."""
+        return cls.default_service(signer)
+
+    @classmethod
+    def new_with_max_sessions(
+        cls, signer: ConsensusSignatureScheme, max_sessions_per_scope: int
+    ) -> "ConsensusService":
+        """reference: src/service.rs:99-109"""
+        return cls.default_service(signer, max_sessions_per_scope)
+
     # ── Accessors (reference: src/service.rs:141-164) ──────────────────
 
     def storage(self) -> ConsensusStorage[Scope]:

@@ -1,0 +1,63 @@
+"""Property-based wire-codec fuzz: round-trips and decoder robustness."""
+
+from hypothesis import given, settings, strategies as st
+
+from hashgraph_tpu.wire import Proposal, Vote
+
+U32 = st.integers(min_value=0, max_value=0xFFFFFFFF)
+U64 = st.integers(min_value=0, max_value=0xFFFFFFFFFFFFFFFF)
+BYTES = st.binary(max_size=80)
+
+votes = st.builds(
+    Vote,
+    vote_id=U32,
+    vote_owner=BYTES,
+    proposal_id=U32,
+    timestamp=U64,
+    vote=st.booleans(),
+    parent_hash=BYTES,
+    received_hash=BYTES,
+    vote_hash=BYTES,
+    signature=BYTES,
+)
+
+proposals = st.builds(
+    Proposal,
+    name=st.text(max_size=40),
+    payload=BYTES,
+    proposal_id=U32,
+    proposal_owner=BYTES,
+    votes=st.lists(votes, max_size=5),
+    expected_voters_count=U32,
+    round=U32,
+    timestamp=U64,
+    expiration_timestamp=U64,
+    liveness_criteria_yes=st.booleans(),
+)
+
+
+@settings(max_examples=300, deadline=None)
+@given(vote=votes)
+def test_vote_roundtrip(vote):
+    assert Vote.decode(vote.encode()) == vote
+
+
+@settings(max_examples=150, deadline=None)
+@given(proposal=proposals)
+def test_proposal_roundtrip(proposal):
+    decoded = Proposal.decode(proposal.encode())
+    assert decoded == proposal
+    # Re-encoding is stable (canonical form).
+    assert decoded.encode() == proposal.encode()
+
+
+@settings(max_examples=300, deadline=None)
+@given(junk=st.binary(max_size=120))
+def test_decoder_never_crashes_unexpectedly(junk):
+    """Arbitrary bytes either decode or raise ValueError — never anything
+    else (no hangs, no index errors)."""
+    for cls in (Vote, Proposal):
+        try:
+            cls.decode(junk)
+        except ValueError:
+            pass
