@@ -29,12 +29,12 @@ import numpy as np
 
 from ..errors import (
     ConsensusError,
+    ConsensusFailed,
     InsufficientVotesAtTimeout,
     ProposalAlreadyExist,
     SessionNotFound,
     StatusCode,
     UserAlreadyVoted,
-    VoterCapacityExceeded,
     error_for_code,
 )
 from ..events import BroadcastEventBus, ConsensusEventBus
@@ -44,7 +44,12 @@ from ..ops.decide import (
     STATE_REACHED_NO,
     STATE_REACHED_YES,
 )
-from ..protocol import build_vote, validate_proposal_timestamp, validate_vote
+from ..protocol import (
+    build_vote,
+    calculate_consensus_result,
+    validate_proposal_timestamp,
+    validate_vote,
+)
 from ..scope_config import ScopeConfig, ScopeConfigBuilder
 from ..service import DEFAULT_MAX_SESSIONS_PER_SCOPE, ConsensusStats
 from ..session import ConsensusConfig, ConsensusSession, ConsensusState
@@ -58,7 +63,7 @@ from ..types import (
 )
 from ..wire import Proposal, Vote
 from .pool import ProposalPool
-from .session_sync import allocate_slot, load_session_rows
+from .session_sync import allocate_slot, load_session_rows, state_code_of
 
 Scope = TypeVar("Scope", bound=Hashable)
 
@@ -74,9 +79,16 @@ _STATE_TO_SCALAR = {
 
 @dataclass
 class SessionRecord(Generic[Scope]):
-    """Host-side view of one pooled session (scalar bookkeeping the device
-    doesn't need; vote bytes kept for gossip reconstruction and chain
-    linking, reference: src/utils.rs:62-77)."""
+    """Host-side view of one session (scalar bookkeeping the device doesn't
+    need; vote bytes kept for gossip reconstruction and chain linking,
+    reference: src/utils.rs:62-77).
+
+    Two substrates share this record type: pooled sessions (``slot`` >= 0,
+    tallies live in device HBM) and host-spilled sessions (``session`` set,
+    ``slot`` a negative synthetic id) — the graceful-degrade path for
+    proposals the fixed pool geometry cannot hold. The reference service has
+    no capacity limits at all (src/service.rs:86-97), so spilling keeps the
+    public API's envelope unbounded even though the device pool is not."""
 
     scope: Scope
     slot: int
@@ -84,6 +96,7 @@ class SessionRecord(Generic[Scope]):
     config: ConsensusConfig
     created_at: int
     votes: dict[bytes, Vote] = field(default_factory=dict)  # accepted only
+    session: ConsensusSession | None = None  # host fallback substrate
 
     def bump_round(self, accepted: int) -> None:
         """Host mirror of the device round update
@@ -146,6 +159,7 @@ class TpuConsensusEngine(Generic[Scope]):
         self._index: dict[tuple[Scope, int], int] = {}  # (scope, pid) -> slot
         self._scopes: dict[Scope, list[int]] = {}  # scope -> slots (insertion order)
         self._scope_configs: dict[Scope, ScopeConfig] = {}
+        self._next_host_slot = -1  # synthetic ids for host-spilled sessions
 
     # ── Accessors ──────────────────────────────────────────────────────
 
@@ -307,21 +321,76 @@ class TpuConsensusEngine(Generic[Scope]):
         proposal: Proposal,
         config: ConsensusConfig,
         now: int,
+        session: ConsensusSession | None = None,
     ) -> SessionRecord[Scope]:
-        slot = allocate_slot(
-            self._pool, (scope, proposal.proposal_id), proposal, config, now
+        """Claim a pool slot for the proposal — or, when the pool geometry
+        cannot hold it (expected_voters_count or embedded voters beyond the
+        lane capacity, or no free slots), degrade to a host-backed scalar
+        session. Registration therefore never fails on capacity, matching the
+        reference service's unbounded envelope (src/service.rs:86-97) and its
+        invariant that session save cannot fail (events may be emitted before
+        registration, src/service.rs:275-277)."""
+        # Per-scope LRU eviction runs BEFORE slot allocation so overflow
+        # eviction can free a device slot for the incoming session (the
+        # reference trims after save, src/service.rs:512-522 — the surviving
+        # set is identical either way, but trimming first avoids stranding
+        # the newcomer on the host path while a freed slot sits idle).
+        if self._evict_for(scope, now):
+            # The incoming session itself loses the LRU ranking (created_at
+            # tie): never tracked, nothing allocated — same observable result
+            # as insert-then-trim.
+            host_session = (
+                session
+                if session is not None
+                else ConsensusSession._new(proposal, config, now)
+            )
+            slot = self._next_host_slot
+            self._next_host_slot -= 1
+            record = SessionRecord(
+                scope=scope,
+                slot=slot,
+                proposal=host_session.proposal,
+                config=config,
+                created_at=now,
+                session=host_session,
+            )
+            record.votes = host_session.votes
+            return record
+        fits = (
+            proposal.expected_voters_count <= self._pool.voter_capacity
+            and (
+                session is None
+                or len(session.votes) <= self._pool.voter_capacity
+            )
+            and self._pool.free_slots > 0
         )
+        if fits:
+            slot = allocate_slot(
+                self._pool, (scope, proposal.proposal_id), proposal, config, now
+            )
+            host_session = None
+        else:
+            slot = self._next_host_slot
+            self._next_host_slot -= 1
+            host_session = (
+                session
+                if session is not None
+                else ConsensusSession._new(proposal, config, now)
+            )
+            self.tracer.count("engine.host_spills")
         record = SessionRecord(
             scope=scope,
             slot=slot,
-            proposal=proposal,
+            proposal=proposal if host_session is None else host_session.proposal,
             config=config,
             created_at=now,
+            session=host_session,
         )
+        if host_session is not None:
+            record.votes = host_session.votes  # shared dict: one source of truth
         self._records[slot] = record
-        self._index[(scope, proposal.proposal_id)] = slot
+        self._index[(scope, record.proposal.proposal_id)] = slot
         self._scopes.setdefault(scope, []).append(slot)
-        self._trim_scope(scope)
         return record
 
     def _register_session(
@@ -330,20 +399,19 @@ class TpuConsensusEngine(Generic[Scope]):
         """Load a scalar session (possibly already decided) into a fresh
         slot — the shared path for validated network proposals and
         storage-backed restore (device tensors are a cache; the session is
-        the source of truth, SURVEY §5 checkpoint row)."""
-        proposal = session.proposal
-        if len(session.votes) > self._pool.voter_capacity:
-            # Reject before touching the pool: nothing to roll back.
-            raise VoterCapacityExceeded(
-                "embedded vote chain exceeds pool voter capacity"
-            )
-        record = self._register(scope, proposal, session.config, created_at)
+        the source of truth, SURVEY §5 checkpoint row). Sessions the pool
+        cannot hold stay host-backed (see _register)."""
+        record = self._register(
+            scope, session.proposal, session.config, created_at, session=session
+        )
         if record.slot not in self._records:
             return  # evicted immediately by the per-scope cap (created_at tie)
+        if record.session is not None:
+            return  # host-backed: the scalar session IS the state
         record.votes = {k: v.clone() for k, v in session.votes.items()}
         if session.votes or not session.state.is_active:
             loaded = load_session_rows(self._pool, record.slot, session)
-            assert loaded  # capacity pre-checked above
+            assert loaded  # capacity pre-checked in _register
 
     # ── Voting ─────────────────────────────────────────────────────────
 
@@ -401,6 +469,12 @@ class TpuConsensusEngine(Generic[Scope]):
         slots = np.empty(batch, np.int64)
         lanes = np.empty(batch, np.int32)
         values = np.empty(batch, bool)
+        # Host-spilled sessions apply immediately but their events are queued
+        # as (batch index, scope, event) and emitted interleaved with the
+        # device path's, preserving per-vote arrival order across substrates.
+        host_events: list[tuple[int, Scope, ConsensusEvent]] = []
+        host_accepted = 0
+        host_transitions = 0
 
         # Batched signature verification: one scheme call for the whole batch
         # (native runtime: one GIL-releasing threaded C call). Verdicts are
@@ -441,6 +515,17 @@ class TpuConsensusEngine(Generic[Scope]):
                 except ConsensusError as exc:
                     statuses[i] = int(exc.code)
                     continue
+            if record.session is not None:
+                was_active = record.session.state.is_active
+                code, event = self._host_add_vote(record, vote, now)
+                statuses[i] = code
+                host_accepted += code == int(StatusCode.OK)
+                host_transitions += (
+                    was_active and not record.session.state.is_active
+                )
+                if event is not None:
+                    host_events.append((i, scope, event))
+                continue
             lane = self._pool.meta(slot).lane_for(
                 vote.vote_owner, self._pool.voter_capacity
             )
@@ -453,6 +538,10 @@ class TpuConsensusEngine(Generic[Scope]):
             dev_rows.append(i)
 
         if not dev_rows:
+            self.tracer.count("engine.votes_accepted", host_accepted)
+            self.tracer.count("engine.transitions", host_transitions)
+            for _, ev_scope, event in host_events:
+                self._emit(ev_scope, event)
             return statuses
 
         k = len(dev_rows)
@@ -463,9 +552,9 @@ class TpuConsensusEngine(Generic[Scope]):
         statuses[np.asarray(dev_rows)] = dev_statuses
         self.tracer.count(
             "engine.votes_accepted",
-            int(np.sum(dev_statuses == int(StatusCode.OK))),
+            int(np.sum(dev_statuses == int(StatusCode.OK))) + host_accepted,
         )
-        self.tracer.count("engine.transitions", len(transitions))
+        self.tracer.count("engine.transitions", len(transitions) + host_transitions)
 
         # Host bookkeeping for accepted votes, in arrival order; remember the
         # last accepted vote per slot — that is the vote that flipped a slot
@@ -493,6 +582,7 @@ class TpuConsensusEngine(Generic[Scope]):
             for slot, new_state in transitions
             if new_state in (STATE_REACHED_YES, STATE_REACHED_NO)
         }
+        pending_events = host_events
         for j, i in enumerate(dev_rows):
             slot = int(slots[j])
             code = int(dev_statuses[j])
@@ -504,15 +594,69 @@ class TpuConsensusEngine(Generic[Scope]):
             if emit_reached:
                 record = self._records[slot]
                 state = self._pool.state_of(slot)
-                self._emit(
-                    record.scope,
-                    ConsensusReached(
-                        proposal_id=record.proposal.proposal_id,
-                        result=state == STATE_REACHED_YES,
-                        timestamp=now,
-                    ),
+                pending_events.append(
+                    (
+                        i,
+                        record.scope,
+                        ConsensusReached(
+                            proposal_id=record.proposal.proposal_id,
+                            result=state == STATE_REACHED_YES,
+                            timestamp=now,
+                        ),
+                    )
                 )
+        pending_events.sort(key=lambda t: t[0])
+        for _, ev_scope, event in pending_events:
+            self._emit(ev_scope, event)
         return statuses
+
+    def _host_add_vote(
+        self, record: SessionRecord[Scope], vote: Vote, now: int
+    ) -> tuple[int, ConsensusEvent | None]:
+        """Apply one validated vote to a host-spilled session, mapping scalar
+        outcomes to the same status codes the device path produces (parity:
+        the scalar session IS the oracle the kernels are fuzzed against).
+        Returns (status code, event-to-emit-or-None); the caller queues the
+        event so emission order follows per-vote arrival order even when a
+        batch mixes substrates."""
+        session = record.session
+        already = session.state.is_reached
+        try:
+            transition = session.add_vote(vote, now)
+        except ConsensusError as exc:
+            return int(exc.code), None
+        event = None
+        if transition.is_reached:
+            event = ConsensusReached(
+                proposal_id=record.proposal.proposal_id,
+                result=transition.reached,
+                timestamp=now,
+            )
+        return (
+            int(StatusCode.ALREADY_REACHED) if already else int(StatusCode.OK),
+            event,
+        )
+
+    def _host_timeout(self, record: SessionRecord[Scope], now: int) -> int:
+        """Timeout decision for a host-spilled session; returns the new dense
+        state code (same contract as pool.timeout rows). Mirrors the scalar
+        service (reference: src/service.rs:323-373): idempotent for decided
+        sessions, Failed sessions stay Failed."""
+        session = record.session
+        if session.state.is_active:
+            result = calculate_consensus_result(
+                session.votes,
+                session.proposal.expected_voters_count,
+                session.config.consensus_threshold,
+                session.proposal.liveness_criteria_yes,
+                True,
+            )
+            session.state = (
+                ConsensusState.reached(result)
+                if result is not None
+                else ConsensusState.failed()
+            )
+        return state_code_of(session.state)
 
     # ── Timeouts ───────────────────────────────────────────────────────
 
@@ -524,7 +668,11 @@ class TpuConsensusEngine(Generic[Scope]):
         slot = self._index.get((scope, proposal_id))
         if slot is None:
             raise SessionNotFound()
-        [(_, new_state)] = self._pool.timeout([slot])
+        record = self._records[slot]
+        if record.session is not None:
+            new_state = self._host_timeout(record, now)
+        else:
+            [(_, new_state)] = self._pool.timeout([slot])
         if new_state in (STATE_REACHED_YES, STATE_REACHED_NO):
             result = new_state == STATE_REACHED_YES
             self._emit(
@@ -547,14 +695,25 @@ class TpuConsensusEngine(Generic[Scope]):
         ingest kernel rejects votes on non-ACTIVE slots) so re-sweeping it
         would deterministically re-fail and re-emit forever."""
         expired: list[int] = []
+        host_expired: list[int] = []
         for slot, record in self._records.items():
-            if self._pool.state_of(slot) == STATE_ACTIVE:
+            if record.session is not None:
+                if (
+                    record.session.state.is_active
+                    and record.proposal.expiration_timestamp <= now
+                ):
+                    host_expired.append(slot)
+            elif self._pool.state_of(slot) == STATE_ACTIVE:
                 if self._pool.meta(slot).expiry <= now:
                     expired.append(slot)
         self.tracer.count("engine.timeout_sweeps")
-        self.tracer.count("engine.timeouts_fired", len(expired))
+        self.tracer.count("engine.timeouts_fired", len(expired) + len(host_expired))
         out: list[tuple[Scope, int, bool | None]] = []
-        for slot, new_state in self._pool.timeout(expired):
+        swept = self._pool.timeout(expired) + [
+            (slot, self._host_timeout(self._records[slot], now))
+            for slot in host_expired
+        ]
+        for slot, new_state in swept:
             record = self._records[slot]
             pid = record.proposal.proposal_id
             if new_state in (STATE_REACHED_YES, STATE_REACHED_NO):
@@ -578,28 +737,31 @@ class TpuConsensusEngine(Generic[Scope]):
         return self._get_record(scope, proposal_id).proposal.clone()
 
     def get_consensus_result(self, scope: Scope, proposal_id: int) -> bool | None:
-        """None while active; ConsensusFailed is surfaced as None too (the
-        reference storage helper returns Err(ConsensusFailed) — scalar
-        wrappers that need the error can check session state)."""
+        """None while active; raises ConsensusFailed for a failed session —
+        the same contract as the storage derived helper
+        (reference: src/storage.rs:112-126), so the framework's two front
+        doors agree."""
         record = self._get_record(scope, proposal_id)
-        state = self._pool.state_of(record.slot)
+        state = self._state_code(record)
         if state == STATE_REACHED_YES:
             return True
         if state == STATE_REACHED_NO:
             return False
+        if state == STATE_FAILED:
+            raise ConsensusFailed()
         return None
 
     def get_active_proposals(self, scope: Scope) -> list[Proposal]:
         return [
             r.proposal.clone()
             for r in self._scope_records(scope)
-            if self._pool.state_of(r.slot) == STATE_ACTIVE
+            if self._state_code(r) == STATE_ACTIVE
         ]
 
     def get_reached_proposals(self, scope: Scope) -> list[tuple[Proposal, bool]]:
         out = []
         for r in self._scope_records(scope):
-            state = self._pool.state_of(r.slot)
+            state = self._state_code(r)
             if state in (STATE_REACHED_YES, STATE_REACHED_NO):
                 out.append((r.proposal.clone(), state == STATE_REACHED_YES))
         return out
@@ -609,7 +771,7 @@ class TpuConsensusEngine(Generic[Scope]):
         stats = ConsensusStats()
         for r in self._scope_records(scope):
             stats.total_sessions += 1
-            state = self._pool.state_of(r.slot)
+            state = self._state_code(r)
             if state == STATE_ACTIVE:
                 stats.active_sessions += 1
             elif state == STATE_FAILED:
@@ -622,6 +784,8 @@ class TpuConsensusEngine(Generic[Scope]):
         """Materialise a scalar ConsensusSession from the pooled state —
         the bridge back to ConsensusStorage backends (checkpoint/interop)."""
         record = self._get_record(scope, proposal_id)
+        if record.session is not None:
+            return record.session.clone()
         return ConsensusSession(
             proposal=record.proposal.clone(),
             state=_STATE_TO_SCALAR[self._pool.state_of(record.slot)],
@@ -676,7 +840,7 @@ class TpuConsensusEngine(Generic[Scope]):
         for slot in slots:
             record = self._records.pop(slot)
             del self._index[(scope, record.proposal.proposal_id)]
-        self._pool.release(slots)
+        self._pool.release([s for s in slots if s >= 0])  # host spills have no slot
         self._scope_configs.pop(scope, None)
 
     # ── Scope config (reference: src/service.rs:375-484) ───────────────
@@ -756,6 +920,13 @@ class TpuConsensusEngine(Generic[Scope]):
 
     # ── Internals ──────────────────────────────────────────────────────
 
+    def _state_code(self, record: SessionRecord[Scope]) -> int:
+        """Dense lifecycle state regardless of substrate: host-mirrored pool
+        state for pooled records, scalar state for host-spilled ones."""
+        if record.session is not None:
+            return state_code_of(record.session.state)
+        return self._pool.state_of(record.slot)
+
     def _get_record(self, scope: Scope, proposal_id: int) -> SessionRecord[Scope]:
         slot = self._index.get((scope, proposal_id))
         if slot is None:
@@ -765,24 +936,32 @@ class TpuConsensusEngine(Generic[Scope]):
     def _scope_records(self, scope: Scope) -> list[SessionRecord[Scope]]:
         return [self._records[s] for s in self._scopes.get(scope, [])]
 
-    def _trim_scope(self, scope: Scope) -> None:
+    def _evict_for(self, scope: Scope, now: int) -> bool:
         """LRU-by-created_at eviction beyond the per-scope cap
-        (reference: src/service.rs:512-522): keep the newest max sessions."""
+        (reference: src/service.rs:512-522), applied for an incoming session
+        stamped ``created_at=now`` *before* it is allocated: keep the newest
+        ``max`` of incumbents+newcomer (ties favor incumbents, matching the
+        insert-then-trim stable sort). Evicts surplus incumbents; returns
+        True when the newcomer itself loses the ranking and must not be
+        tracked."""
         slots = self._scopes.get(scope, [])
-        if len(slots) <= self._max_sessions_per_scope:
-            return
+        if len(slots) + 1 <= self._max_sessions_per_scope:
+            return False
+        newcomer = object()  # appended last: loses created_at ties
         ranked = sorted(
-            slots,
-            key=lambda s: self._records[s].created_at,
+            [*slots, newcomer],
+            key=lambda s: now if s is newcomer else self._records[s].created_at,
             reverse=True,
         )
         keep = set(ranked[: self._max_sessions_per_scope])
         evicted = [s for s in slots if s not in keep]
-        self._scopes[scope] = [s for s in slots if s in keep]
-        for slot in evicted:
-            record = self._records.pop(slot)
-            del self._index[(scope, record.proposal.proposal_id)]
-        self._pool.release(evicted)
+        if evicted:
+            self._scopes[scope] = [s for s in slots if s in keep]
+            for slot in evicted:
+                record = self._records.pop(slot)
+                del self._index[(scope, record.proposal.proposal_id)]
+            self._pool.release([s for s in evicted if s >= 0])
+        return newcomer not in keep
 
     def _emit(self, scope: Scope, event: ConsensusEvent) -> None:
         self._event_bus.publish(scope, event)

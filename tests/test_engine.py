@@ -25,7 +25,7 @@ from hashgraph_tpu import (
     UserAlreadyVoted,
     build_vote,
 )
-from hashgraph_tpu.engine import PoolFullError, ProposalPool, TpuConsensusEngine
+from hashgraph_tpu.engine import ProposalPool, TpuConsensusEngine
 from hashgraph_tpu.errors import VoterCapacityExceeded
 
 from common import NOW, make_service, random_stub_signer
@@ -349,14 +349,20 @@ class TestEngineLifecycle:
         # Evicted slots are reusable.
         assert engine.pool().free_slots == 6
 
-    def test_pool_exhaustion(self):
+    def test_pool_exhaustion_spills_to_host(self):
+        # The reference service has no capacity limits (src/service.rs:86-97);
+        # when the device pool is full the engine degrades to a host-backed
+        # session instead of erroring (see test_engine_spill.py for the full
+        # spilled-session lifecycle).
         engine = TpuConsensusEngine(
             random_stub_signer(), capacity=2, voter_capacity=4
         )
         engine.create_proposal("a", request(3), NOW)
         engine.create_proposal("b", request(3), NOW)
-        with pytest.raises(PoolFullError):
-            engine.create_proposal("c", request(3), NOW)
+        pid = engine.create_proposal("c", request(3), NOW).proposal_id
+        assert engine.pool().free_slots == 0
+        assert engine.get_consensus_result("c", pid) is None
+        assert engine.get_scope_stats("c").active_sessions == 1
 
     def test_delete_scope_frees_slots(self):
         engine = make_engine()

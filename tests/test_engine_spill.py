@@ -1,0 +1,318 @@
+"""Host-spill lifecycle: sessions the pool geometry cannot hold degrade to
+host-backed scalar sessions with identical observable semantics.
+
+The reference service has no capacity limits at all (reference:
+src/service.rs:86-97 — unbounded sessions, any u32 expected_voters_count);
+the engine's fixed pool geometry must therefore never surface as an API
+error. These tests drive spilled sessions through the full lifecycle —
+voting, consensus, timeout, events, stats, eviction, checkpoint — and pin
+parity between a spilled engine and a scalar service."""
+
+import pytest
+
+from hashgraph_tpu import (
+    CreateProposalRequest,
+    InMemoryConsensusStorage,
+    StatusCode,
+    build_vote,
+)
+from hashgraph_tpu.engine import TpuConsensusEngine
+from hashgraph_tpu.errors import ConsensusFailed, InsufficientVotesAtTimeout
+from hashgraph_tpu.types import ConsensusFailedEvent, ConsensusReached
+
+from common import NOW, random_stub_signer
+
+
+def request(n=3, name="prop", exp=1000, liveness=True) -> CreateProposalRequest:
+    return CreateProposalRequest(
+        name=name,
+        payload=b"payload",
+        proposal_owner=b"owner",
+        expected_voters_count=n,
+        expiration_timestamp=exp,
+        liveness_criteria_yes=liveness,
+    )
+
+
+def drain(receiver):
+    events = []
+    while (item := receiver.try_recv()) is not None:
+        events.append(item)
+    return events
+
+
+def tiny_engine(**kw) -> TpuConsensusEngine:
+    kw.setdefault("capacity", 1)
+    kw.setdefault("voter_capacity", 4)
+    return TpuConsensusEngine(random_stub_signer(), **kw)
+
+
+class TestSpillOnPoolExhaustion:
+    def test_spilled_session_reaches_consensus(self):
+        engine = tiny_engine()
+        receiver = engine.event_bus().subscribe()
+        engine.create_proposal("s", request(3, name="pooled"), NOW)
+        pid = engine.create_proposal("s", request(3, name="spilled"), NOW).proposal_id
+        assert engine.pool().free_slots == 0
+
+        for _ in range(2):
+            vote = build_vote(
+                engine.get_proposal("s", pid), True, random_stub_signer(), NOW
+            )
+            assert engine.ingest_votes([("s", vote)], NOW)[0] == int(StatusCode.OK)
+        assert engine.get_consensus_result("s", pid) is True
+        assert ("s", ConsensusReached(pid, True, NOW)) in drain(receiver)
+
+    def test_spilled_vote_after_reached_is_already_reached(self):
+        engine = tiny_engine()
+        engine.create_proposal("s", request(3), NOW)
+        pid = engine.create_proposal("s", request(3, name="sp"), NOW).proposal_id
+        receiver = engine.event_bus().subscribe()
+        statuses = []
+        for _ in range(3):
+            vote = build_vote(
+                engine.get_proposal("s", pid), True, random_stub_signer(), NOW
+            )
+            statuses.append(engine.ingest_votes([("s", vote)], NOW)[0])
+        assert statuses == [
+            int(StatusCode.OK),
+            int(StatusCode.OK),
+            int(StatusCode.ALREADY_REACHED),
+        ]
+        # The deciding vote emits, and the late vote re-emits (reference:
+        # src/session.rs:246 returns the existing result -> another event).
+        events = drain(receiver)
+        assert events.count(("s", ConsensusReached(pid, True, NOW))) == 2
+
+    def test_spilled_duplicate_and_cast_vote(self):
+        engine = tiny_engine()
+        engine.create_proposal("s", request(3), NOW)
+        pid = engine.create_proposal("s", request(3, name="sp"), NOW).proposal_id
+        signer = random_stub_signer()
+        vote = build_vote(engine.get_proposal("s", pid), True, signer, NOW)
+        assert engine.ingest_votes([("s", vote)], NOW)[0] == int(StatusCode.OK)
+        dup = build_vote(engine.get_proposal("s", pid), False, signer, NOW)
+        assert engine.ingest_votes([("s", dup)], NOW)[0] == int(
+            StatusCode.DUPLICATE_VOTE
+        )
+        # cast_vote funnels through the same host path.
+        engine.cast_vote("s", pid, True, NOW)
+        assert engine.get_proposal("s", pid).round == 2  # gossipsub bump
+
+    def test_mixed_batch_pooled_and_spilled(self):
+        engine = tiny_engine(capacity=2)
+        pids = [
+            engine.create_proposal("s", request(3, name=f"p{i}"), NOW).proposal_id
+            for i in range(4)  # 2 pooled + 2 spilled
+        ]
+        items = []
+        for pid in pids:
+            items.append(
+                (
+                    "s",
+                    build_vote(
+                        engine.get_proposal("s", pid), True, random_stub_signer(), NOW
+                    ),
+                )
+            )
+        statuses = engine.ingest_votes(items, NOW, pre_validated=True)
+        assert list(statuses) == [int(StatusCode.OK)] * 4
+        assert engine.get_scope_stats("s").total_sessions == 4
+        for pid in pids:
+            assert engine.get_consensus_result("s", pid) is None  # 1 of 3 votes
+            assert len(engine.get_proposal("s", pid).votes) == 1
+
+    def test_mixed_batch_event_arrival_order(self):
+        # Proposals with n=1 decide on their single vote; batch order is
+        # pooled A (idx 0), spilled B (idx 1), pooled C (idx 2). Events must
+        # come out A, B, C — per-vote arrival order across substrates.
+        engine = tiny_engine(capacity=2)
+        pids = [
+            engine.create_proposal("s", request(1, name=f"p{i}"), NOW).proposal_id
+            for i in range(3)  # p0, p1 pooled; p2 spilled
+        ]
+        receiver = engine.event_bus().subscribe()
+        order = [pids[0], pids[2], pids[1]]  # pooled, spilled, pooled
+        items = [
+            (
+                "s",
+                build_vote(
+                    engine.get_proposal("s", pid), True, random_stub_signer(), NOW
+                ),
+            )
+            for pid in order
+        ]
+        statuses = engine.ingest_votes(items, NOW, pre_validated=True)
+        assert list(statuses) == [int(StatusCode.OK)] * 3
+        emitted = [e.proposal_id for _, e in drain(receiver)]
+        assert emitted == order
+
+
+class TestSpillOnVoterCapacity:
+    def test_oversized_voter_count_spills(self):
+        engine = tiny_engine(capacity=8, voter_capacity=4)
+        # 9 expected voters > 4 lanes: must not error (reference accepts any
+        # u32 n), runs host-backed instead.
+        pid = engine.create_proposal("s", request(9), NOW).proposal_id
+        assert engine.pool().allocated_slots == 0
+        signers = [random_stub_signer() for _ in range(7)]
+        for signer in signers[:6]:
+            vote = build_vote(engine.get_proposal("s", pid), True, signer, NOW)
+            assert engine.ingest_votes([("s", vote)], NOW)[0] == int(StatusCode.OK)
+        # ceil(9 * 2/3) = 6 YES with 3 silent -> quorum gate still blocked
+        # pre-timeout (total 6 < required 6? no: 6 >= 6, yes_w=6 > no_w=0).
+        assert engine.get_consensus_result("s", pid) is True
+
+    def test_incoming_proposal_with_oversized_chain_spills(self):
+        # Build a 5-vote chain on a scalar-capable engine, ship it to an
+        # engine whose pool has only 4 lanes: it must load host-backed.
+        origin = TpuConsensusEngine(
+            random_stub_signer(), capacity=8, voter_capacity=8
+        )
+        pid = origin.create_proposal("s", request(7), NOW).proposal_id
+        for _ in range(5):
+            vote = build_vote(
+                origin.get_proposal("s", pid), True, random_stub_signer(), NOW
+            )
+            origin.ingest_votes([("s", vote)], NOW)
+        wire_proposal = origin.get_proposal("s", pid)
+
+        receiver_engine = TpuConsensusEngine(
+            random_stub_signer(), capacity=8, voter_capacity=4
+        )
+        receiver_engine.process_incoming_proposal("s", wire_proposal, NOW)
+        assert receiver_engine.pool().allocated_slots == 0
+        # 5 YES of 7, req = ceil(14/3) = 5: reached YES during replay.
+        assert receiver_engine.get_consensus_result("s", pid) is True
+
+
+class TestSpilledTimeouts:
+    def test_spilled_timeout_reaches_by_liveness(self):
+        engine = tiny_engine()
+        engine.create_proposal("s", request(3), NOW)
+        pid = engine.create_proposal(
+            "s", request(5, name="sp", exp=50), NOW
+        ).proposal_id
+        vote = build_vote(
+            engine.get_proposal("s", pid), True, random_stub_signer(), NOW
+        )
+        engine.ingest_votes([("s", vote)], NOW)
+        # Timeout: quorum gate uses n; 1 YES + 4 silent liveness-YES -> True.
+        assert engine.handle_consensus_timeout("s", pid, NOW + 100) is True
+        # Idempotent re-fire.
+        assert engine.handle_consensus_timeout("s", pid, NOW + 200) is True
+
+    def test_spilled_timeout_fails_and_raises(self):
+        # Threshold 1.0 with a 1-YES/1-NO split cannot decide even with
+        # silent weighting, so the timeout fails the session.
+        signers = [random_stub_signer() for _ in range(2)]
+        engine2 = tiny_engine()
+        engine2.scope("x").with_threshold(1.0).initialize()
+        engine2.create_proposal("x", request(3), NOW)
+        pid2 = engine2.create_proposal(
+            "x", request(4, name="sp", exp=50, liveness=False), NOW
+        ).proposal_id
+        receiver2 = engine2.event_bus().subscribe()
+        for i, signer in enumerate(signers):
+            v = build_vote(engine2.get_proposal("x", pid2), i == 0, signer, NOW)
+            engine2.ingest_votes([("x", v)], NOW)
+        with pytest.raises(InsufficientVotesAtTimeout):
+            engine2.handle_consensus_timeout("x", pid2, NOW + 100)
+        assert ("x", ConsensusFailedEvent(pid2, NOW + 100)) in drain(receiver2)
+        with pytest.raises(ConsensusFailed):
+            engine2.get_consensus_result("x", pid2)
+
+    def test_sweep_covers_spilled_sessions(self):
+        engine = tiny_engine()
+        pid_pooled = engine.create_proposal(
+            "s", request(5, name="pooled", exp=50), NOW
+        ).proposal_id
+        pid_spilled = engine.create_proposal(
+            "s", request(5, name="spilled", exp=50), NOW
+        ).proposal_id
+        for pid in (pid_pooled, pid_spilled):
+            vote = build_vote(
+                engine.get_proposal("s", pid), True, random_stub_signer(), NOW
+            )
+            engine.ingest_votes([("s", vote)], NOW)
+        swept = engine.sweep_timeouts(NOW + 100)
+        assert ("s", pid_pooled, True) in swept
+        assert ("s", pid_spilled, True) in swept
+
+
+class TestSpilledLifecycle:
+    def test_eviction_frees_slot_for_newcomer(self):
+        # Eviction runs before allocation: with a 1-slot pool and a 1-session
+        # scope cap, each newer proposal evicts the older AND takes its
+        # device slot — it must not strand on the host path.
+        engine = tiny_engine(capacity=1, max_sessions_per_scope=1)
+        pid1 = engine.create_proposal("s", request(3, name="p1"), NOW).proposal_id
+        pid2 = engine.create_proposal("s", request(3, name="p2"), NOW + 1).proposal_id
+        assert engine.get_scope_stats("s").total_sessions == 1
+        assert engine.pool().allocated_slots == 1  # p2 is pooled, not spilled
+        with pytest.raises(Exception):
+            engine.get_proposal("s", pid1)
+        assert engine.get_proposal("s", pid2).name == "p2"
+
+    def test_newcomer_losing_lru_tie_is_dropped(self):
+        # created_at tie: incumbents win, the newcomer is never tracked
+        # (insert-then-trim parity with the reference's stable sort).
+        engine = tiny_engine(capacity=4, max_sessions_per_scope=1)
+        pid1 = engine.create_proposal("s", request(3, name="p1"), NOW).proposal_id
+        pid2 = engine.create_proposal("s", request(3, name="p2"), NOW).proposal_id
+        assert engine.get_scope_stats("s").total_sessions == 1
+        assert engine.get_proposal("s", pid1).name == "p1"
+        with pytest.raises(Exception):
+            engine.get_proposal("s", pid2)
+        assert engine.pool().allocated_slots == 1
+
+    def test_eviction_and_delete_scope_with_spills(self):
+        engine = tiny_engine(max_sessions_per_scope=2)
+        pids = [
+            engine.create_proposal("s", request(3, name=f"p{i}"), NOW + i).proposal_id
+            for i in range(4)
+        ]
+        assert engine.get_scope_stats("s").total_sessions == 2
+        engine.delete_scope("s")
+        assert engine.get_scope_stats("s").total_sessions == 0
+        assert engine.pool().free_slots == engine.pool().capacity
+        assert pids  # ids were all distinct
+
+    def test_checkpoint_roundtrip_with_spilled_session(self):
+        engine = tiny_engine()
+        pid_pooled = engine.create_proposal("s", request(3, name="a"), NOW).proposal_id
+        pid_spilled = engine.create_proposal("s", request(3, name="b"), NOW).proposal_id
+        vote = build_vote(
+            engine.get_proposal("s", pid_spilled), True, random_stub_signer(), NOW
+        )
+        engine.ingest_votes([("s", vote)], NOW)
+
+        storage = InMemoryConsensusStorage()
+        assert engine.save_to_storage(storage) == 2
+
+        # Restore into a roomy engine: the spilled session becomes pooled.
+        restored = TpuConsensusEngine(
+            random_stub_signer(), capacity=8, voter_capacity=8
+        )
+        assert restored.load_from_storage(storage) == 2
+        assert restored.pool().allocated_slots == 2
+        assert restored.get_proposal("s", pid_pooled).name == "a"
+        assert len(restored.get_proposal("s", pid_spilled).votes) == 1
+
+        # Restore into a too-small engine: sessions spill, nothing raises
+        # (previously a mid-restore VoterCapacityExceeded abort).
+        cramped = TpuConsensusEngine(
+            random_stub_signer(), capacity=1, voter_capacity=8
+        )
+        assert cramped.load_from_storage(storage) == 2
+        assert cramped.get_scope_stats("s").total_sessions == 2
+
+    def test_export_session_of_spilled(self):
+        engine = tiny_engine()
+        engine.create_proposal("s", request(3), NOW)
+        pid = engine.create_proposal("s", request(3, name="sp"), NOW).proposal_id
+        engine.cast_vote("s", pid, True, NOW)
+        session = engine.export_session("s", pid)
+        assert session.state.is_active
+        assert len(session.votes) == 1
+        assert session.proposal.round == 2
