@@ -1,21 +1,29 @@
-"""Benchmarks over the device pool (BASELINE.md configs).
+"""Benchmarks for the BASELINE.md configs.
 
-Default (bare ``python bench.py``) runs config 3 — 10k concurrent proposals
-× 64 voters, batched tally, single TPU core — and prints ONE JSON line:
-votes ingested/sec vs the 1M/s north-star baseline. Other configs via argv:
+Default (bare ``python bench.py``) runs the full sweep and prints ONE JSON
+line whose headline metric is **engine-level** vote-ingest throughput at
+config-3 scale (10k concurrent proposals × 64 voters, single TPU core) —
+the full TpuConsensusEngine service surface: proposal-id resolution, voter
+lane resolution, per-vote status codes, round bookkeeping, event emission —
+driven through the columnar batch API. ``detail`` carries every other
+BASELINE shape:
 
-  python bench.py config2   # 1 proposal x 1024 voters, P2P: finality latency
-  python bench.py config4   # scopes x proposals x 256 voters, 30% absent,
-                            # liveness-timeout path (sharded when >1 device)
-  python bench.py config5   # streaming mixed Gossipsub+P2P replay
-  python bench.py all
+  pool_level   raw ProposalPool throughput, same shape (no service layer)
+  config2      1 proposal × 1024 voters, P2P: p50 finality latency
+  config4      256 scopes × 1k proposals × 256 voters, 30% absent,
+               liveness-timeout path (sharded when >1 device)
+  config5      streaming mixed Gossipsub+P2P replay to 1M proposals
+  lanes1024    12k proposals × 1024 voter lanes (per-chip slice of the
+               100k-proposal north star)
+
+Individual runs via argv: engine | pool (alias config3) | config2 |
+config4 | config5 | lanes1024 | crypto | validated | default | all
+(``all`` prints newline-separated JSON, one line per section).
 
 Traces are pre-validated replays (signature/hash verification is the
-pluggable host stage, benchmarked separately in tests/test_native.py; the
-reference's own tests hand-deliver already-validated votes the same way) —
-these measure the consensus engine proper: packed transfer → scatter →
-arrival-ordered scan → fused decision kernel → status readback, pipelined
-the way a streaming embedder would drive it.
+pluggable host stage — measured separately by ``python bench.py crypto``
+and the validated end-to-end mode; the reference's own tests hand-deliver
+already-validated votes the same way).
 """
 
 from __future__ import annotations
@@ -113,6 +121,314 @@ def run_bench(
     }
 
 
+def run_engine_bench(
+    p_count: int = 10_240, v_count: int = 64, cycles: int = 6
+) -> dict:
+    """Engine-level config 3: the full TpuConsensusEngine service surface —
+    batch proposal creation, vectorized proposal-id + voter-lane resolution,
+    per-vote status codes, round bookkeeping, event emission — via the
+    columnar API. This is the honest north-star number (the service the
+    embedder actually calls); ``run_bench`` measures the raw pool under it.
+    """
+    import jax
+
+    from hashgraph_tpu import CreateProposalRequest, StubConsensusSigner
+    from hashgraph_tpu.engine import TpuConsensusEngine
+
+    now = 1_700_000_000
+    engine = TpuConsensusEngine(
+        StubConsensusSigner(b"\x01" * 20),
+        capacity=p_count,
+        voter_capacity=v_count,
+        max_sessions_per_scope=p_count + 1,
+    )
+    engine.scope("s").with_threshold(1.0).initialize()
+    requests = [
+        CreateProposalRequest(
+            name="p",
+            payload=b"",
+            proposal_owner=b"o",
+            expected_voters_count=v_count,
+            expiration_timestamp=10_000,
+            liveness_criteria_yes=True,
+        )
+        for _ in range(p_count)
+    ]
+    gids = np.array(
+        [
+            engine.voter_gid(bytes([1 + (i % 250), i // 250]) + b"\x00" * 18)
+            for i in range(v_count)
+        ],
+        np.int64,
+    )
+    col_gids = np.repeat(gids, p_count)
+    col_vals = (np.arange(p_count * v_count) % 2).astype(bool)
+
+    ingest_rates, create_rates = [], []
+    for cycle in range(cycles + 1):  # first is compile warmup
+        engine.delete_scope("s")
+        engine.scope("s").with_threshold(1.0).initialize()
+        t0 = time.perf_counter()
+        proposals = engine.create_proposals("s", requests, now)
+        t1 = time.perf_counter()
+        pids = np.fromiter(
+            (p.proposal_id for p in proposals), np.int64, p_count
+        )
+        col_pids = np.tile(pids, v_count)
+        t2 = time.perf_counter()
+        statuses = engine.ingest_columnar("s", col_pids, col_gids, col_vals, now)
+        t3 = time.perf_counter()
+        if cycle == 0:
+            assert int(np.sum(statuses == 0)) == p_count * v_count, "not all OK"
+        else:
+            create_rates.append(p_count / (t1 - t0))
+            ingest_rates.append(p_count * v_count / (t3 - t2))
+    ingest_rates.sort()
+    create_rates.sort()
+    throughput = ingest_rates[len(ingest_rates) // 2]
+    return {
+        "metric": "engine_vote_ingest_throughput",
+        "value": round(throughput, 1),
+        "unit": "votes/sec",
+        "vs_baseline": round(throughput / 1_000_000, 4),
+        "detail": {
+            "proposals": p_count,
+            "voters": v_count,
+            "cycles": cycles,
+            "ingest_rates": [round(r, 1) for r in ingest_rates],
+            "proposal_creation_rate": round(
+                create_rates[len(create_rates) // 2], 1
+            ),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
+def run_lanes1024(p_count: int = 12_288, v_count: int = 1024) -> dict:
+    """1024-voter-lane pool run: ~the per-chip slice of 100k concurrent
+    1024-voter proposals on a v5e-8 (BASELINE north-star shape)."""
+    import jax
+
+    from hashgraph_tpu.engine.pool import ProposalPool
+    from hashgraph_tpu.ops.decide import required_votes_np
+
+    rng = np.random.default_rng(3)
+    now = 1_700_000_000
+    pool = ProposalPool(p_count, v_count)
+    L = 8
+    fill = 672  # most of the ceil(2n/3)=683 quorum, no mid-stream decisions
+
+    def allocate(cycle: int) -> None:
+        pool.allocate_batch(
+            keys=[(cycle, i) for i in range(p_count)],
+            n=np.full(p_count, v_count),
+            req=required_votes_np(np.full(p_count, v_count), 2.0 / 3.0),
+            cap=np.full(p_count, 2),
+            gossip=np.ones(p_count, bool),
+            liveness=np.ones(p_count, bool),
+            expiry=np.full(p_count, now + 10_000),
+            created_at=np.full(p_count, now),
+        )
+
+    def run_cycle() -> int:
+        pendings = []
+        votes = 0
+        for base in range(0, fill, L):
+            slots = np.repeat(np.arange(p_count, dtype=np.int64), L)
+            lanes = np.tile(np.arange(base, base + L, dtype=np.int32), p_count)
+            values = rng.random(p_count * L) < 0.5
+            pendings.append(pool.ingest_async(slots, lanes, values, now))
+            votes += p_count * L
+            if len(pendings) >= 16:
+                pool.complete_all(pendings)
+                pendings = []
+        if pendings:
+            pool.complete_all(pendings)
+        return votes
+
+    allocate(0)
+    run_cycle()  # warmup/compile
+    rates = []
+    for cycle in range(1, 4):
+        pool.release(list(range(p_count)))
+        allocate(cycle)
+        start = time.perf_counter()
+        votes = run_cycle()
+        rates.append(votes / (time.perf_counter() - start))
+    rates.sort()
+    throughput = rates[len(rates) // 2]
+    return {
+        "metric": "lanes1024_ingest_throughput",
+        "value": round(throughput, 1),
+        "unit": "votes/sec",
+        "vs_baseline": round(throughput / 1_000_000, 4),
+        "detail": {
+            "proposals": p_count,
+            "voter_lanes": v_count,
+            "votes_per_cycle": p_count * fill,
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
+def run_crypto(count: int = 4096) -> dict:
+    """Host crypto stage: native threaded ECDSA recover throughput
+    (the reference's dominant validate_vote cost,
+    /root/reference/src/utils.rs:150-158)."""
+    from hashgraph_tpu import EthereumConsensusSigner
+    from hashgraph_tpu import native
+
+    signers = [EthereumConsensusSigner.random() for _ in range(8)]
+    payloads = [b"vote-payload-%d" % i for i in range(count)]
+    t0 = time.perf_counter()
+    sigs = [signers[i % 8].sign(p) for i, p in enumerate(payloads)]
+    sign_rate = count / (time.perf_counter() - t0)
+    idents = [signers[i % 8].identity() for i in range(count)]
+    # Warmup (thread pool spinup) then timed run.
+    EthereumConsensusSigner.verify_batch(idents[:64], payloads[:64], sigs[:64])
+    t0 = time.perf_counter()
+    verdicts = EthereumConsensusSigner.verify_batch(idents, payloads, sigs)
+    verify_rate = count / (time.perf_counter() - t0)
+    assert all(v is True for v in verdicts)
+    return {
+        "metric": "ecdsa_verify_throughput",
+        "value": round(verify_rate, 1),
+        "unit": "sigs/sec",
+        "vs_baseline": None,
+        "detail": {
+            "signatures": count,
+            "sign_rate": round(sign_rate, 1),
+            "native_runtime": native.available(),
+        },
+    }
+
+
+def run_validated(p_count: int = 512, v_count: int = 16) -> dict:
+    """End-to-end validated ingest: real EIP-191 ECDSA signatures through
+    host validation (structural checks + hash recompute + native batched
+    recover) into the columnar device path — the full
+    process_incoming_vote pipeline at batch scale, nothing pre-validated.
+    """
+    from hashgraph_tpu import (
+        CreateProposalRequest,
+        EthereumConsensusSigner,
+        StubConsensusSigner,
+    )
+    from hashgraph_tpu.engine import TpuConsensusEngine
+    from hashgraph_tpu.protocol import compute_vote_hash
+    from hashgraph_tpu.wire import Vote
+
+    now = 1_700_000_000
+    engine = TpuConsensusEngine(
+        EthereumConsensusSigner.random(),
+        capacity=p_count,
+        voter_capacity=v_count,
+        max_sessions_per_scope=p_count + 1,
+    )
+    engine.scope("s").with_threshold(1.0).initialize()
+    # Device-path warmup on a throwaway scope (same grid shapes) so the
+    # reported host/device split is steady-state, not compile time.
+    warm = engine.create_proposals(
+        "warm",
+        [
+            CreateProposalRequest(
+                name="w",
+                payload=b"",
+                proposal_owner=b"o",
+                expected_voters_count=v_count,
+                expiration_timestamp=10_000,
+                liveness_criteria_yes=True,
+            )
+            for _ in range(p_count)
+        ],
+        now,
+    )
+    warm_gids = np.array(
+        [engine.voter_gid(bytes([1 + i]) * 20) for i in range(v_count)], np.int64
+    )
+    engine.ingest_columnar(
+        "warm",
+        np.tile(np.fromiter((p.proposal_id for p in warm), np.int64, p_count), v_count),
+        np.repeat(warm_gids, p_count),
+        np.zeros(p_count * v_count, bool),
+        now,
+    )
+    engine.delete_scope("warm")
+
+    proposals = engine.create_proposals(
+        "s",
+        [
+            CreateProposalRequest(
+                name="p",
+                payload=b"",
+                proposal_owner=b"o",
+                expected_voters_count=v_count,
+                expiration_timestamp=10_000,
+                liveness_criteria_yes=True,
+            )
+            for _ in range(p_count)
+        ],
+        now,
+    )
+    signers = [EthereumConsensusSigner.random() for _ in range(v_count)]
+    votes: list[Vote] = []
+    for lane, signer in enumerate(signers):
+        for p in proposals:
+            vote = Vote(
+                vote_id=lane + 1,
+                vote_owner=signer.identity(),
+                proposal_id=p.proposal_id,
+                timestamp=now,
+                vote=bool(lane % 2),
+                parent_hash=b"",
+                received_hash=b"",
+                vote_hash=b"",
+                signature=b"",
+            )
+            vote.vote_hash = compute_vote_hash(vote)
+            vote.signature = signer.sign(vote.signing_payload())
+            votes.append(vote)
+
+    total = len(votes)
+    gids = np.fromiter(
+        (engine.voter_gid(v.vote_owner) for v in votes), np.int64, total
+    )
+    pids = np.fromiter((v.proposal_id for v in votes), np.int64, total)
+    vals = np.fromiter((v.vote for v in votes), bool, total)
+
+    start = time.perf_counter()
+    # Host validation stage (reference: src/utils.rs:127-171 order):
+    # structural + hash equality + batched signature recovery.
+    hashes = [compute_vote_hash(v) for v in votes]
+    hash_ok = all(h == v.vote_hash for h, v in zip(hashes, votes))
+    t_hash = time.perf_counter()
+    verdicts = EthereumConsensusSigner.verify_batch(
+        [v.vote_owner for v in votes],
+        [v.signing_payload() for v in votes],
+        [v.signature for v in votes],
+    )
+    sig_ok = all(v is True for v in verdicts)
+    t_verify = time.perf_counter()
+    statuses = engine.ingest_columnar("s", pids, gids, vals, now)
+    t_ingest = time.perf_counter()
+    assert hash_ok and sig_ok
+    assert int(np.sum(statuses == 0)) == total
+    elapsed = t_ingest - start
+    return {
+        "metric": "validated_ingest_throughput",
+        "value": round(total / elapsed, 1),
+        "unit": "votes/sec",
+        "vs_baseline": round(total / elapsed / 1_000_000, 4),
+        "detail": {
+            "votes": total,
+            "hash_seconds": round(t_hash - start, 3),
+            "verify_seconds": round(t_verify - t_hash, 3),
+            "device_ingest_seconds": round(t_ingest - t_verify, 3),
+            "host_share_pct": round(100 * (t_verify - start) / elapsed, 1),
+        },
+    }
+
+
 def run_config2(voters: int = 1024, repeats: int = 9) -> dict:
     """1 proposal × 1024 voters, P2P dynamic rounds: p50 finality latency.
 
@@ -167,7 +483,7 @@ def run_config2(voters: int = 1024, repeats: int = 9) -> dict:
 
 
 def run_config4(
-    scopes: int = 64, proposals_per_scope: int = 256, voters: int = 256
+    scopes: int = 256, proposals_per_scope: int = 1000, voters: int = 256
 ) -> dict:
     """Byzantine/absent liveness path: 30% of voters never vote; sessions
     finalize via the timeout sweep. Sharded over all available devices."""
@@ -243,10 +559,13 @@ def run_config4(
     }
 
 
-def run_config5(p_count: int = 65_536, v_count: int = 48) -> dict:
-    """Streaming mixed Gossipsub+P2P replay: a large arrival-ordered trace
-    applied through the pipelined ingest path (config-5 scaled to one chip;
-    the full 1M-proposal replay is this shape run repeatedly)."""
+def run_config5(
+    p_count: int = 65_536, v_count: int = 48, waves: int = 16
+) -> dict:
+    """Streaming mixed Gossipsub+P2P replay to 1M proposals: ``waves``
+    arrival-ordered populations (16 × 65536 ≈ 1.05M) streamed through the
+    pipelined ingest path, each wave recycling the pool like a live
+    deployment churns sessions."""
     import jax
 
     from hashgraph_tpu.engine.pool import ProposalPool
@@ -255,40 +574,51 @@ def run_config5(p_count: int = 65_536, v_count: int = 48) -> dict:
     rng = np.random.default_rng(23)
     now = 1_700_000_000
     pool = ProposalPool(p_count, v_count)
+    all_slots = list(range(p_count))
 
-    gossip = rng.random(p_count) < 0.5
-    caps = np.where(gossip, 2, (2 * v_count + 2) // 3)
-    pool.allocate_batch(
-        keys=[("stream", i) for i in range(p_count)],
-        n=np.full(p_count, v_count),
-        req=required_votes_np(np.full(p_count, v_count), 2.0 / 3.0),
-        cap=caps,
-        gossip=gossip,
-        liveness=rng.random(p_count) < 0.5,
-        expiry=np.full(p_count, now + 10_000),
-        created_at=np.full(p_count, now),
-    )
+    def allocate(wave: int) -> None:
+        gossip = rng.random(p_count) < 0.5
+        caps = np.where(gossip, 2, (2 * v_count + 2) // 3)
+        pool.allocate_batch(
+            keys=[(wave, i) for i in range(p_count)],
+            n=np.full(p_count, v_count),
+            req=required_votes_np(np.full(p_count, v_count), 2.0 / 3.0),
+            cap=caps,
+            gossip=gossip,
+            liveness=rng.random(p_count) < 0.5,
+            expiry=np.full(p_count, now + 10_000),
+            created_at=np.full(p_count, now),
+        )
 
-    # Stream rounds of one-vote-per-proposal through the full voter set:
-    # gossip sessions decide once quorum lands (~vote 32 of 48), P2P
-    # sessions hit their ceil(2n/3) caps, and later rounds exercise the
-    # ALREADY_REACHED / SESSION_NOT_ACTIVE absorption paths — exactly like
-    # a replayed gossip trace.
-    rounds = v_count
+    def stream_wave() -> int:
+        # Rounds of one-vote-per-proposal through the full voter set:
+        # gossip sessions decide once quorum lands (~vote 32 of 48), P2P
+        # sessions hit their ceil(2n/3) caps, and later rounds exercise the
+        # ALREADY_REACHED / SESSION_NOT_ACTIVE absorption paths — exactly
+        # like a replayed gossip trace.
+        votes = 0
+        pendings = []
+        slots = np.arange(p_count, dtype=np.int64)
+        for r in range(v_count):
+            lanes = np.full(p_count, r, np.int32)
+            values = rng.random(p_count) < 0.55
+            pendings.append(pool.ingest_async(slots, lanes, values, now))
+            votes += p_count
+            if len(pendings) >= 8:
+                pool.complete_all(pendings)
+                pendings = []
+        if pendings:
+            pool.complete_all(pendings)
+        return votes
+
+    allocate(0)
+    stream_wave()  # warmup/compile wave (uncounted)
     total_votes = 0
     start = time.perf_counter()
-    pendings = []
-    slots = np.arange(p_count, dtype=np.int64)
-    for r in range(rounds):
-        lanes = np.full(p_count, r, np.int32)
-        values = rng.random(p_count) < 0.55
-        pendings.append(pool.ingest_async(slots, lanes, values, now))
-        total_votes += p_count
-        if len(pendings) >= 8:
-            pool.complete_all(pendings)
-            pendings = []
-    if pendings:
-        pool.complete_all(pendings)
+    for wave in range(waves):
+        pool.release(all_slots)
+        allocate(wave + 1)
+        total_votes += stream_wave()
     elapsed = time.perf_counter() - start
 
     counts = pool.state_counts()
@@ -299,28 +629,75 @@ def run_config5(p_count: int = 65_536, v_count: int = 48) -> dict:
         "unit": "votes/sec",
         "vs_baseline": round(throughput / 1_000_000, 4),
         "detail": {
-            "proposals": p_count,
+            "proposals_replayed": p_count * waves,
+            "pool_slots": p_count,
             "voters": v_count,
             "votes": total_votes,
             "seconds": round(elapsed, 3),
-            "final_state_counts": {str(k): v for k, v in counts.items()},
+            "proposals_per_sec": round(p_count * waves / elapsed, 1),
+            "final_wave_state_counts": {str(k): v for k, v in counts.items()},
             "platform": jax.devices()[0].platform,
         },
+    }
+
+
+def run_default() -> dict:
+    """The driver-visible sweep: engine-level config 3 as the headline,
+    every other BASELINE shape in ``detail`` (one JSON line total)."""
+    engine = run_engine_bench()
+    sections = {
+        "pool_level": run_bench(),
+        "config2": run_config2(),
+        "lanes1024": run_lanes1024(),
+        "validated": run_validated(),
+        "crypto": run_crypto(),
+        "config4": run_config4(),
+        "config5": run_config5(),
+    }
+    detail = dict(engine["detail"])
+    for name, result in sections.items():
+        detail[name] = {
+            "metric": result["metric"],
+            "value": result["value"],
+            "unit": result["unit"],
+            "detail": result["detail"],
+        }
+    return {
+        "metric": engine["metric"],
+        "value": engine["value"],
+        "unit": engine["unit"],
+        "vs_baseline": engine["vs_baseline"],
+        "detail": detail,
     }
 
 
 if __name__ == "__main__":
     import sys
 
-    which = sys.argv[1] if len(sys.argv) > 1 else "config3"
+    which = sys.argv[1] if len(sys.argv) > 1 else "default"
     runners = {
+        "engine": run_engine_bench,
+        "pool": run_bench,
+        "config3": run_bench,  # historical alias
         "config2": run_config2,
-        "config3": run_bench,
         "config4": run_config4,
         "config5": run_config5,
+        "lanes1024": run_lanes1024,
+        "crypto": run_crypto,
+        "validated": run_validated,
+        "default": run_default,
     }
     if which == "all":
-        for name, fn in runners.items():
-            print(json.dumps(fn()))
+        for name in (
+            "engine",
+            "pool",
+            "config2",
+            "lanes1024",
+            "validated",
+            "crypto",
+            "config4",
+            "config5",
+        ):
+            print(json.dumps(runners[name]()))
     else:
         print(json.dumps(runners[which]()))

@@ -160,6 +160,9 @@ class TpuConsensusEngine(Generic[Scope]):
         self._scopes: dict[Scope, list[int]] = {}  # scope -> slots (insertion order)
         self._scope_configs: dict[Scope, ScopeConfig] = {}
         self._next_host_slot = -1  # synthetic ids for host-spilled sessions
+        # Columnar-path cache: per-scope sorted (pids, slots) arrays for
+        # vectorized proposal-id resolution; dropped on any membership change.
+        self._pid_tables: dict[Scope, tuple[np.ndarray, np.ndarray]] = {}
 
     # ── Accessors ──────────────────────────────────────────────────────
 
@@ -195,6 +198,131 @@ class TpuConsensusEngine(Generic[Scope]):
         resolved = self._resolve_config(scope, config, proposal)
         self._register(scope, proposal, resolved, now)
         return proposal.clone()
+
+    def create_proposals(
+        self,
+        scope: Scope,
+        requests: list[CreateProposalRequest],
+        now: int,
+        config: ConsensusConfig | None = None,
+    ) -> list[Proposal]:
+        """Batch counterpart of create_proposal: one device dispatch claims
+        and configures every slot (pool.allocate_batch), instead of one
+        dispatch per proposal. No reference analogue (its creation path is a
+        scalar call, src/service.rs:183-209) — this is the TPU-native bulk
+        feed for large concurrent-proposal populations (BASELINE configs
+        3-5). Success semantics match calling create_proposal in a loop;
+        the error path is batch-atomic (any invalid request raises before
+        anything registers, unlike the loop which keeps earlier items).
+        """
+        existing = len(self._scopes.get(scope, []))
+        if existing + len(requests) > self._max_sessions_per_scope:
+            # Near the per-scope cap eviction interleaves with insertion;
+            # keep that path scalar (it cannot be the hot case — the cap
+            # bounds the scope's total population).
+            return [
+                self.create_proposal(scope, r, now, config) for r in requests
+            ]
+        from ..ops.decide import required_votes_np
+
+        proposals: list[Proposal] = []
+        configs: list[ConsensusConfig] = []
+        for request in requests:
+            proposal = request.into_proposal(now)
+            validate_proposal_timestamp(proposal.expiration_timestamp, now)
+            proposals.append(proposal)
+            configs.append(self._resolve_config(scope, config, proposal))
+
+        free = self._pool.free_slots
+        fit_idx: list[int] = []
+        for i, proposal in enumerate(proposals):
+            if (
+                proposal.expected_voters_count <= self._pool.voter_capacity
+                and len(fit_idx) < free
+            ):
+                fit_idx.append(i)
+        slots_by_item: dict[int, int] = {}
+        if fit_idx:
+            count = len(fit_idx)
+            n_arr = np.fromiter(
+                (proposals[i].expected_voters_count for i in fit_idx),
+                np.int64,
+                count,
+            )
+            thr_arr = np.fromiter(
+                (configs[i].consensus_threshold for i in fit_idx),
+                np.float64,
+                count,
+            )
+            gossip_arr = np.fromiter(
+                (configs[i].use_gossipsub_rounds for i in fit_idx), bool, count
+            )
+            maxr_arr = np.fromiter(
+                (configs[i].max_rounds for i in fit_idx), np.int64, count
+            )
+            req_arr = required_votes_np(n_arr, thr_arr)
+            # max_round_limit semantics (reference: src/session.rs:120-128):
+            # gossipsub -> max_rounds; P2P -> explicit override, else the
+            # dynamic ceil(n*t) cap — which shares calculate_threshold_based_
+            # value with required votes (src/utils.rs:292-304), so req_arr
+            # doubles as the dynamic cap.
+            cap_arr = np.where(
+                gossip_arr,
+                maxr_arr,
+                np.where(maxr_arr == 0, req_arr, maxr_arr),
+            )
+            slots = self._pool.allocate_batch(
+                keys=[
+                    (scope, proposals[i].proposal_id) for i in fit_idx
+                ],
+                n=n_arr,
+                req=req_arr,
+                cap=cap_arr,
+                gossip=gossip_arr,
+                liveness=np.fromiter(
+                    (proposals[i].liveness_criteria_yes for i in fit_idx),
+                    bool,
+                    len(fit_idx),
+                ),
+                expiry=np.fromiter(
+                    (proposals[i].expiration_timestamp for i in fit_idx),
+                    np.int64,
+                    len(fit_idx),
+                ),
+                created_at=np.full(len(fit_idx), now, np.int64),
+            )
+            slots_by_item = dict(zip(fit_idx, slots))
+
+        scope_slots = self._scopes.setdefault(scope, [])
+        for i, proposal in enumerate(proposals):
+            slot = slots_by_item.get(i)
+            if slot is None:  # host spill (oversized n or pool exhausted)
+                host_session = ConsensusSession._new(proposal, configs[i], now)
+                slot = self._next_host_slot
+                self._next_host_slot -= 1
+                record = SessionRecord(
+                    scope=scope,
+                    slot=slot,
+                    proposal=proposal,
+                    config=configs[i],
+                    created_at=now,
+                    session=host_session,
+                )
+                record.votes = host_session.votes
+                self.tracer.count("engine.host_spills")
+            else:
+                record = SessionRecord(
+                    scope=scope,
+                    slot=slot,
+                    proposal=proposal,
+                    config=configs[i],
+                    created_at=now,
+                )
+            self._records[slot] = record
+            self._index[(scope, proposal.proposal_id)] = slot
+            scope_slots.append(slot)
+        self._pid_tables.pop(scope, None)
+        return [p.clone() for p in proposals]
 
     def process_incoming_proposal(
         self, scope: Scope, proposal: Proposal, now: int
@@ -391,6 +519,7 @@ class TpuConsensusEngine(Generic[Scope]):
         self._records[slot] = record
         self._index[(scope, record.proposal.proposal_id)] = slot
         self._scopes.setdefault(scope, []).append(slot)
+        self._pid_tables.pop(scope, None)
         return record
 
     def _register_session(
@@ -526,9 +655,7 @@ class TpuConsensusEngine(Generic[Scope]):
                 if event is not None:
                     host_events.append((i, scope, event))
                 continue
-            lane = self._pool.meta(slot).lane_for(
-                vote.vote_owner, self._pool.voter_capacity
-            )
+            lane = self._pool.lane_for(slot, vote.vote_owner)
             if lane is None:
                 statuses[i] = int(StatusCode.VOTER_CAPACITY_EXCEEDED)
                 continue
@@ -609,6 +736,205 @@ class TpuConsensusEngine(Generic[Scope]):
         for _, ev_scope, event in pending_events:
             self._emit(ev_scope, event)
         return statuses
+
+    def voter_gid(self, owner: bytes) -> int:
+        """Intern an owner identity for the columnar ingest path."""
+        return self._pool.voter_gid(owner)
+
+    def ingest_columnar(
+        self,
+        scope: Scope,
+        proposal_ids: np.ndarray,
+        voter_gids: np.ndarray,
+        values: np.ndarray,
+        now: int,
+        max_depth: int = 8,
+    ) -> np.ndarray:
+        """THE throughput path: apply an arrival-ordered vote batch given as
+        dense columns (structure-of-arrays) — proposal ids, interned voter
+        ids (:meth:`voter_gid`), yes/no values — with zero per-vote Python.
+
+        Same observable semantics as :meth:`ingest_votes` with
+        ``pre_validated=True`` (validation, when needed, happens upstream:
+        wire decode + signature verification are batch host stages), with
+        two deliberate trade-offs, both documented in PARITY.md:
+        - no per-vote ``Vote`` objects are accumulated host-side, so gossip
+          reconstruction/export sees tallies but not vote chains;
+        - event ordering is guaranteed per-session, not across sessions.
+
+        Resolution is fully vectorized (sorted-array searchsorted for
+        proposal→slot, dense lane tables for voter→lane), and the device
+        work is split into bounded-depth dispatches pipelined through
+        ``ingest_async`` so scan depth never exceeds ``max_depth`` and
+        transfers overlap device compute. Returns int32 statuses in batch
+        order (reference semantics per code, as ingest_votes).
+        """
+        from .pool import group_batch
+
+        proposal_ids = np.asarray(proposal_ids, np.int64)
+        voter_gids = np.asarray(voter_gids, np.int64)
+        values = np.asarray(values, bool)
+        batch = len(proposal_ids)
+        self.tracer.count("engine.votes_in", batch)
+        statuses = np.full(batch, int(StatusCode.SESSION_NOT_FOUND), np.int32)
+        if batch == 0:
+            return statuses
+
+        pids_sorted, slots_sorted = self._pid_table(scope)
+        if len(pids_sorted):
+            pos = np.searchsorted(pids_sorted, proposal_ids)
+            pos = np.clip(pos, 0, len(pids_sorted) - 1)
+            found = pids_sorted[pos] == proposal_ids
+            slots = np.where(found, slots_sorted[pos], 0)
+        else:
+            found = np.zeros(batch, bool)
+            slots = np.zeros(batch, np.int64)
+
+        # Host-spilled sessions (negative slots): rare scalar fallback.
+        host_rows = np.nonzero(found & (slots < 0))[0]
+        for i in host_rows:
+            record = self._records[int(slots[i])]
+            owner = self._pool.owner_of_gid(int(voter_gids[i]))
+            vote = Vote(
+                vote_id=0,
+                vote_owner=owner,
+                proposal_id=int(proposal_ids[i]),
+                timestamp=now,
+                vote=bool(values[i]),
+                parent_hash=b"",
+                received_hash=b"",
+                vote_hash=b"columnar",
+                signature=b"columnar",
+            )
+            was_active = record.session.state.is_active
+            code, event = self._host_add_vote(record, vote, now)
+            statuses[i] = code
+            self.tracer.count(
+                "engine.votes_accepted", int(code == int(StatusCode.OK))
+            )
+            self.tracer.count(
+                "engine.transitions",
+                int(was_active and not record.session.state.is_active),
+            )
+            if event is not None:
+                self._emit(scope, event)
+
+        dev_rows = np.nonzero(found & (slots >= 0))[0]
+        if dev_rows.size == 0:
+            return statuses
+        dslots = slots[dev_rows]
+        lanes = self._pool.lanes_for_batch(dslots, voter_gids[dev_rows])
+        no_lane = lanes < 0
+        if no_lane.any():
+            statuses[dev_rows[no_lane]] = int(StatusCode.VOTER_CAPACITY_EXCEEDED)
+            dev_rows = dev_rows[~no_lane]
+            dslots = dslots[~no_lane]
+            lanes = lanes[~no_lane]
+            if dev_rows.size == 0:
+                return statuses
+        dvals = values[dev_rows]
+
+        # Bounded-depth pipelining: the kernel's scan length is the deepest
+        # per-slot chain in a dispatch; segmenting by per-slot occurrence
+        # index keeps every dispatch at depth <= max_depth and lets the
+        # async queue overlap transfers with device compute.
+        _, _, col, depth = group_batch(dslots)
+        seg_members: list[np.ndarray]
+        if depth > max_depth:
+            segs = col // max_depth
+            n_seg = int(segs.max()) + 1
+            order = np.argsort(segs, kind="stable")  # arrival order per segment
+            bounds = np.searchsorted(segs[order], np.arange(1, n_seg))
+            seg_members = np.split(order, bounds)
+        else:
+            seg_members = [np.arange(dev_rows.size)]
+
+        pendings = []
+        for members in seg_members:
+            pendings.append(
+                self._pool.ingest_async(
+                    dslots[members], lanes[members], dvals[members], now
+                )
+            )
+        with self.tracer.span("engine.device_ingest", votes=int(dev_rows.size)):
+            results = self._pool.complete_all(pendings)
+
+        accepted = 0
+        reached_transitions: list[tuple[int, int]] = []
+        already_per_slot: dict[int, int] = {}
+        n_transitions = 0
+        for members, (seg_statuses, transitions) in zip(seg_members, results):
+            statuses[dev_rows[members]] = seg_statuses
+            accepted += int(np.sum(seg_statuses == int(StatusCode.OK)))
+            n_transitions += len(transitions)
+            for slot, new_state in transitions:
+                if new_state in (STATE_REACHED_YES, STATE_REACHED_NO):
+                    reached_transitions.append((slot, new_state))
+            ar_mask = seg_statuses == int(StatusCode.ALREADY_REACHED)
+            if ar_mask.any():
+                ar_slots, ar_counts = np.unique(
+                    dslots[members][ar_mask], return_counts=True
+                )
+                for slot, c in zip(ar_slots.tolist(), ar_counts.tolist()):
+                    already_per_slot[slot] = already_per_slot.get(slot, 0) + c
+        self.tracer.count("engine.votes_accepted", accepted)
+        self.tracer.count("engine.transitions", n_transitions)
+
+        # Round bookkeeping, one pass per touched slot (host mirror of the
+        # device round update; totals are order-independent).
+        ok_mask = statuses[dev_rows] == int(StatusCode.OK)
+        if ok_mask.any():
+            ok_slots, ok_counts = np.unique(
+                dslots[ok_mask], return_counts=True
+            )
+            for slot, c in zip(ok_slots.tolist(), ok_counts.tolist()):
+                self._records[slot].bump_round(int(c))
+
+        # Events: one ConsensusReached per deciding transition plus one per
+        # late (ALREADY_REACHED) vote — same per-session counts as the
+        # scalar path; cross-session order is per-slot grouped.
+        for slot, new_state in reached_transitions:
+            record = self._records[slot]
+            self._emit(
+                record.scope,
+                ConsensusReached(
+                    proposal_id=record.proposal.proposal_id,
+                    result=new_state == STATE_REACHED_YES,
+                    timestamp=now,
+                ),
+            )
+        for slot, count in already_per_slot.items():
+            record = self._records[slot]
+            state = self._pool.state_of(slot)
+            event = ConsensusReached(
+                proposal_id=record.proposal.proposal_id,
+                result=state == STATE_REACHED_YES,
+                timestamp=now,
+            )
+            for _ in range(count):
+                self._emit(record.scope, event)
+        return statuses
+
+    def _pid_table(self, scope: Scope) -> tuple[np.ndarray, np.ndarray]:
+        """Sorted (proposal_ids, slots) arrays for one scope — the
+        vectorized replacement for per-vote dict lookups; rebuilt lazily
+        after any membership change."""
+        table = self._pid_tables.get(scope)
+        if table is None:
+            scope_slots = self._scopes.get(scope, [])
+            pids = np.fromiter(
+                (
+                    self._records[s].proposal.proposal_id
+                    for s in scope_slots
+                ),
+                np.int64,
+                len(scope_slots),
+            )
+            slot_arr = np.fromiter(scope_slots, np.int64, len(scope_slots))
+            order = np.argsort(pids)
+            table = (pids[order], slot_arr[order])
+            self._pid_tables[scope] = table
+        return table
 
     def _host_add_vote(
         self, record: SessionRecord[Scope], vote: Vote, now: int
@@ -842,6 +1168,7 @@ class TpuConsensusEngine(Generic[Scope]):
             del self._index[(scope, record.proposal.proposal_id)]
         self._pool.release([s for s in slots if s >= 0])  # host spills have no slot
         self._scope_configs.pop(scope, None)
+        self._pid_tables.pop(scope, None)
 
     # ── Scope config (reference: src/service.rs:375-484) ───────────────
 
@@ -961,6 +1288,7 @@ class TpuConsensusEngine(Generic[Scope]):
                 record = self._records.pop(slot)
                 del self._index[(scope, record.proposal.proposal_id)]
             self._pool.release([s for s in evicted if s >= 0])
+            self._pid_tables.pop(scope, None)
         return newcomer not in keep
 
     def _emit(self, scope: Scope, event: ConsensusEvent) -> None:
@@ -981,8 +1309,11 @@ def _synchronized(fn):
 # (bounded queues, silent drop), so holding the lock across them is safe.
 for _name in (
     "create_proposal",
+    "create_proposals",
     "process_incoming_proposal",
     "ingest_proposals",
+    "ingest_columnar",
+    "voter_gid",
     "cast_vote",
     "cast_vote_and_get_proposal",
     "process_incoming_vote",

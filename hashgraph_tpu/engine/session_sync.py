@@ -72,11 +72,10 @@ def load_session_rows(
     vcap = pool.voter_capacity
     if len(session.votes) > vcap:
         return False
-    meta = pool.meta(slot)
     mask = np.zeros((1, vcap), bool)
     vals = np.zeros((1, vcap), bool)
     for owner, vote in session.votes.items():
-        lane = meta.lane_for(owner, vcap)
+        lane = pool.lane_for(slot, owner)
         if lane is None:
             return False
         mask[0, lane] = True

@@ -1,0 +1,271 @@
+"""Columnar (structure-of-arrays) engine paths: create_proposals and
+ingest_columnar must be observably equivalent to their scalar counterparts.
+
+The columnar path is the framework's throughput surface (BASELINE north
+star: >=1M vote-ingests/sec at the service level); these tests pin its
+semantics to the per-vote path — statuses, final states, event counts,
+duplicate/capacity/unknown handling — on randomized traces."""
+
+import numpy as np
+import pytest
+
+from hashgraph_tpu import CreateProposalRequest, StatusCode, build_vote
+from hashgraph_tpu.engine import TpuConsensusEngine
+
+from common import NOW, random_stub_signer
+
+
+def request(n=4, name="p", exp=1000, liveness=True):
+    return CreateProposalRequest(
+        name=name,
+        payload=b"x",
+        proposal_owner=b"o",
+        expected_voters_count=n,
+        expiration_timestamp=exp,
+        liveness_criteria_yes=liveness,
+    )
+
+
+def make_engine(**kw):
+    kw.setdefault("capacity", 64)
+    kw.setdefault("voter_capacity", 8)
+    kw.setdefault("max_sessions_per_scope", 1000)
+    return TpuConsensusEngine(random_stub_signer(), **kw)
+
+
+def drain(receiver):
+    events = []
+    while (item := receiver.try_recv()) is not None:
+        events.append(item)
+    return events
+
+
+class TestCreateProposalsBatch:
+    def test_equivalent_to_scalar_loop(self):
+        batch_engine = make_engine()
+        scalar_engine = make_engine()
+        reqs = [request(n=3 + (i % 4), name=f"p{i}") for i in range(10)]
+        batch_proposals = batch_engine.create_proposals("s", reqs, NOW)
+        scalar_proposals = [
+            scalar_engine.create_proposal("s", r, NOW) for r in reqs
+        ]
+        assert len(batch_proposals) == 10
+        assert batch_engine.get_scope_stats("s").total_sessions == 10
+        for bp, sp in zip(batch_proposals, scalar_proposals):
+            assert bp.expected_voters_count == sp.expected_voters_count
+            assert bp.round == sp.round == 1
+            # Same resolved config on both engines' records.
+            b_rec = batch_engine._records[batch_engine._index[("s", bp.proposal_id)]]
+            s_rec = scalar_engine._records[scalar_engine._index[("s", sp.proposal_id)]]
+            assert b_rec.config == s_rec.config
+
+    def test_batch_with_spills(self):
+        engine = make_engine(capacity=4, voter_capacity=4)
+        # 6 requests into a 4-slot pool, one oversized: 3 pooled + spills.
+        reqs = [request(n=4, name=f"p{i}") for i in range(5)] + [
+            request(n=100, name="big")
+        ]
+        proposals = engine.create_proposals("s", reqs, NOW)
+        assert len(proposals) == 6
+        assert engine.get_scope_stats("s").total_sessions == 6
+        assert engine.pool().allocated_slots == 4
+        # The oversized one runs host-backed and still takes votes.
+        big = proposals[-1]
+        vote = build_vote(
+            engine.get_proposal("s", big.proposal_id), True, random_stub_signer(), NOW
+        )
+        assert engine.ingest_votes([("s", vote)], NOW)[0] == int(StatusCode.OK)
+
+    def test_batch_respects_scope_cap(self):
+        engine = make_engine(max_sessions_per_scope=3)
+        proposals = engine.create_proposals(
+            "s", [request(name=f"p{i}") for i in range(5)], NOW + 1
+        )
+        assert len(proposals) == 5
+        assert engine.get_scope_stats("s").total_sessions == 3
+
+    def test_p2p_cap_matches_scalar(self):
+        from hashgraph_tpu.scope_config import NetworkType
+
+        engine = make_engine()
+        engine.scope("s").with_network_type(NetworkType.P2P).initialize()
+        [p] = engine.create_proposals("s", [request(n=6)], NOW)
+        slot = engine._index[("s", p.proposal_id)]
+        scalar_engine = make_engine()
+        scalar_engine.scope("s").with_network_type(NetworkType.P2P).initialize()
+        sp = scalar_engine.create_proposal("s", request(n=6), NOW)
+        s_slot = scalar_engine._index[("s", sp.proposal_id)]
+        b_cap = int(np.asarray(engine.pool()._cap)[slot])
+        s_cap = int(np.asarray(scalar_engine.pool()._cap)[s_slot])
+        assert b_cap == s_cap == 4  # ceil(2*6/3)
+
+
+class TestColumnarIngestParity:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_random_trace_parity_with_ingest_votes(self, seed):
+        rng = np.random.default_rng(seed)
+        n_props, n_voters = 6, 6
+        col_engine = make_engine()
+        vote_engine = make_engine()
+        col_engine.scope("s").with_threshold(1.0).initialize()
+        vote_engine.scope("s").with_threshold(1.0).initialize()
+        reqs = [request(n=n_voters, name=f"p{i}") for i in range(n_props)]
+        col_pids = [p.proposal_id for p in col_engine.create_proposals("s", reqs, NOW)]
+        vote_pids = [p.proposal_id for p in vote_engine.create_proposals("s", reqs, NOW)]
+
+        owners = [bytes([10 + i]) * 20 for i in range(n_voters)]
+        gids = [col_engine.voter_gid(o) for o in owners]
+        col_rx = col_engine.event_bus().subscribe()
+        vote_rx = vote_engine.event_bus().subscribe()
+
+        # Random arrival-ordered trace with duplicates sprinkled in.
+        trace = []  # (prop_idx, voter_idx, value)
+        for _ in range(n_props * n_voters + 10):
+            trace.append(
+                (
+                    int(rng.integers(n_props)),
+                    int(rng.integers(n_voters)),
+                    bool(rng.random() < 0.5),
+                )
+            )
+
+        from hashgraph_tpu.wire import Vote
+
+        col_statuses = col_engine.ingest_columnar(
+            "s",
+            np.array([col_pids[p] for p, _, _ in trace], np.int64),
+            np.array([gids[v] for _, v, _ in trace], np.int64),
+            np.array([val for _, _, val in trace], bool),
+            NOW,
+            max_depth=3,  # force multi-segment
+        )
+        vote_items = [
+            (
+                "s",
+                Vote(
+                    vote_id=1,
+                    vote_owner=owners[v],
+                    proposal_id=vote_pids[p],
+                    timestamp=NOW,
+                    vote=val,
+                    parent_hash=b"",
+                    received_hash=b"",
+                    vote_hash=b"h",
+                    signature=b"s",
+                ),
+            )
+            for p, v, val in trace
+        ]
+        vote_statuses = vote_engine.ingest_votes(vote_items, NOW, pre_validated=True)
+
+        assert list(col_statuses) == list(vote_statuses)
+        for cp, vp in zip(col_pids, vote_pids):
+            c_state = col_engine._state_code(
+                col_engine._records[col_engine._index[("s", cp)]]
+            )
+            v_state = vote_engine._state_code(
+                vote_engine._records[vote_engine._index[("s", vp)]]
+            )
+            assert c_state == v_state
+            # Round bookkeeping parity.
+            assert (
+                col_engine.get_proposal("s", cp).round
+                == vote_engine.get_proposal("s", vp).round
+            )
+        # Event parity: same multiset of (pid-index, result) with same counts.
+        col_events = sorted(
+            (col_pids.index(e.proposal_id), e.result) for _, e in drain(col_rx)
+        )
+        vote_events = sorted(
+            (vote_pids.index(e.proposal_id), e.result) for _, e in drain(vote_rx)
+        )
+        assert col_events == vote_events
+
+    def test_unknown_pid_and_capacity(self):
+        engine = make_engine(voter_capacity=2)
+        [p] = engine.create_proposals("s", [request(n=8, name="x")], NOW)
+        # n=8 > 2 lanes: spilled to host; columnar falls back per vote.
+        gid = engine.voter_gid(b"\x01" * 20)
+        st = engine.ingest_columnar(
+            "s",
+            np.array([p.proposal_id, 999_999_999], np.int64),
+            np.array([gid, gid], np.int64),
+            np.array([True, True], bool),
+            NOW,
+        )
+        assert st[0] == int(StatusCode.OK)  # host-backed fallback accepted
+        assert st[1] == int(StatusCode.SESSION_NOT_FOUND)
+
+    def test_lane_capacity_exceeded_columnar(self):
+        engine = make_engine(capacity=4, voter_capacity=2)
+        engine.scope("s").with_threshold(1.0).initialize()
+        [p] = engine.create_proposals("s", [request(n=2, name="x")], NOW)
+        gids = np.array(
+            [engine.voter_gid(bytes([i]) * 20) for i in range(1, 4)], np.int64
+        )
+        st = engine.ingest_columnar(
+            "s",
+            np.full(3, p.proposal_id, np.int64),
+            gids,
+            np.array([True, False, True], bool),
+            NOW,
+        )
+        # Two lanes assigned; the third distinct owner exhausts capacity.
+        assert list(st[:2]) == [int(StatusCode.OK)] * 2
+        assert st[2] == int(StatusCode.VOTER_CAPACITY_EXCEEDED)
+
+    def test_already_reached_reemission_counts(self):
+        engine = make_engine()
+        [p] = engine.create_proposals("s", [request(n=2, name="x")], NOW)
+        rx = engine.event_bus().subscribe()
+        gids = np.array(
+            [engine.voter_gid(bytes([i]) * 20) for i in range(1, 5)], np.int64
+        )
+        st = engine.ingest_columnar(
+            "s",
+            np.full(4, p.proposal_id, np.int64),
+            gids,
+            np.ones(4, bool),
+            NOW,
+            max_depth=1,
+        )
+        # n=2 unanimity: decided on vote 2; votes 3-4 are late.
+        assert list(st) == [
+            int(StatusCode.OK),
+            int(StatusCode.OK),
+            int(StatusCode.ALREADY_REACHED),
+            int(StatusCode.ALREADY_REACHED),
+        ]
+        events = drain(rx)
+        assert len(events) == 3  # deciding emit + 2 re-emits
+        assert all(e.result is True for _, e in events)
+
+
+class TestLaneBatchResolution:
+    def test_mixed_existing_and_new(self):
+        from hashgraph_tpu.engine import ProposalPool
+
+        pool = ProposalPool(4, 3)
+        pool.allocate_batch(
+            keys=["a", "b"],
+            n=np.array([3, 3]),
+            req=np.array([2, 2]),
+            cap=np.array([2, 2]),
+            gossip=np.array([True, True]),
+            liveness=np.array([True, True]),
+            expiry=np.array([100, 100]),
+            created_at=np.array([0, 0]),
+        )
+        g = [pool.voter_gid(bytes([i]) * 4) for i in range(6)]
+        # Scalar assignment first.
+        assert pool.lane_for(0, bytes([0]) * 4) == 0
+        # Batch: slot0 sees existing gid0 + new gid1; slot1 all new; then
+        # gid1 repeats on slot0 (same lane), overflow on slot1.
+        lanes = pool.lanes_for_batch(
+            np.array([0, 0, 1, 1, 0, 1, 1]),
+            np.array([g[0], g[1], g[2], g[3], g[1], g[4], g[5]]),
+        )
+        assert list(lanes) == [0, 1, 0, 1, 1, 2, -1]
+        # Scalar sees batch assignments.
+        assert pool.lane_for(1, bytes([2]) * 4) == 0
+        assert pool.lane_for(0, bytes([1]) * 4) == 1
