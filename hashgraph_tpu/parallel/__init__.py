@@ -8,6 +8,7 @@ axis. Collectives (psum over ICI) appear only in global aggregation.
 
 from .mesh import PROPOSAL_AXIS, consensus_mesh
 from .multihost import (
+    MultiHostPool,
     distributed_consensus_mesh,
     initialize_distributed,
     local_slot_range,
@@ -17,6 +18,7 @@ from .sharded import ShardedPool
 __all__ = [
     "consensus_mesh",
     "ShardedPool",
+    "MultiHostPool",
     "PROPOSAL_AXIS",
     "initialize_distributed",
     "distributed_consensus_mesh",

@@ -1,38 +1,50 @@
-"""Multi-host scaffolding: process initialization + the scale-out design.
+"""Multi-host pool: the slot axis sharded across the devices of many
+processes, with process-local data feeding.
 
-Single-host multi-device is fully implemented (ShardedPool over a mesh,
-validated on virtual 8-device meshes and the driver's multi-chip dry run).
-This module holds the multi-host entry point and documents how the design
-extends — it is scaffolding in the honest sense: initialization and mesh
-construction work on any jax.distributed deployment, while the per-process
-data-feeding path below is exercised only single-host in this repo.
-
-Scale-out design (the scaling-book recipe applied to consensus):
+Execution model (the scaling-book recipe applied to consensus):
 
 - **Slot ownership follows device ownership.** The global pool's slot axis
-  shards over the full mesh; each process owns the contiguous slot ranges of
-  its addressable devices. The host-side router (`ShardedPool._route`)
-  already computes per-device sections — multi-host, each process simply
-  materializes only its own sections (`jax.make_array_from_process_local_data`)
-  instead of the full batch.
-- **Vote traffic is DCN-free by construction.** The embedder's transport
-  (gossip) delivers votes to whichever host received them; a thin
-  shard-aware relay forwards each vote to the process owning its proposal's
-  slot — consensus state itself never crosses DCN. The only collective,
-  the psum in `global_state_counts`, rides ICI within a slice and DCN
-  across slices, and it is O(#states) per sweep.
+  shards over the full mesh; each process owns the contiguous slot ranges
+  of its addressable devices (`local_slot_range`).
+- **Control plane is replicated.** Allocation, release, snapshot loads, and
+  timeout sweeps must be invoked with identical arguments on every process
+  (standard jax SPMD: same program, same global shapes). Host bookkeeping
+  stays consistent because these ops are deterministic.
+- **Data plane is process-local.** Each process ingests only votes for its
+  own slots (the embedder's shard-aware relay forwards votes to the owning
+  host — consensus state itself never crosses DCN). The routed batch is
+  materialized per process via ``jax.make_array_from_process_local_data``:
+  nobody ever holds the global batch, and readbacks pull only addressable
+  shards. Per-dispatch grid shapes are agreed with one tiny allgather so
+  every process compiles the same program.
+- **Events are emitted by the owning process only** (ingest statuses and
+  timeout transitions are returned for local slots), so a fleet of engine
+  front-ends never double-publishes.
 - **Signatures verify where votes arrive** (host CPU, native runtime), so
   adding hosts scales verification linearly with the fleet, independent of
   the TPU topology.
+
+The 2-process CPU integration test (tests/test_multihost.py) spawns real
+``jax.distributed`` processes and drives allocation, cross-process ingest,
+psum stats, and the timeout sweep end-to-end.
 """
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
+from jax.experimental import multihost_utils
 
 from .mesh import PROPOSAL_AXIS, consensus_mesh
+from .sharded import ShardedPool
 
-__all__ = ["initialize_distributed", "distributed_consensus_mesh"]
+__all__ = [
+    "initialize_distributed",
+    "distributed_consensus_mesh",
+    "local_slot_range",
+    "MultiHostPool",
+]
 
 
 def initialize_distributed(
@@ -68,8 +80,16 @@ def local_slot_range(
     mesh).
     """
     mesh = mesh if mesh is not None else distributed_consensus_mesh()
+    start, stop = _local_device_span(mesh)
+    return (start * capacity_per_device, stop * capacity_per_device)
+
+
+def _local_device_span(mesh) -> tuple[int, int]:
+    """[start, stop) positions of this process's devices in mesh order."""
     devices = list(mesh.devices.flat)
-    local = [i for i, d in enumerate(devices) if d.process_index == jax.process_index()]
+    local = [
+        i for i, d in enumerate(devices) if d.process_index == jax.process_index()
+    ]
     if not local:
         return (0, 0)
     start, stop = min(local), max(local) + 1
@@ -78,4 +98,204 @@ def local_slot_range(
             "this process's devices are not contiguous in the mesh; "
             "reorder the mesh so slot ranges stay process-local"
         )
-    return (start * capacity_per_device, stop * capacity_per_device)
+    return (start, stop)
+
+
+class MultiHostPool(ShardedPool):
+    """ShardedPool across the devices of many ``jax.distributed`` processes.
+
+    Contract (module docstring has the full model):
+    - control-plane calls (``allocate_batch``, ``release``, ``load_rows``,
+      ``timeout``) are collective with IDENTICAL arguments on every process;
+    - ``ingest_async``/``complete_all`` are collective in *cadence* (every
+      process dispatches the same number of batches, empty ones included)
+      but each process passes only votes for its own slots
+      (``local_slot_range``); statuses/transitions come back for local
+      votes/slots only, so each process emits events for what it owns;
+    - per-dispatch grid shapes are agreed via one small allgather.
+    """
+
+    def __init__(self, capacity_per_device, voter_capacity, mesh=None):
+        mesh = mesh if mesh is not None else distributed_consensus_mesh()
+        # Span first: _init_device_arrays (called from the base ctor) needs
+        # it to materialize process-local sections.
+        self._dev_lo, self._dev_hi = _local_device_span(mesh)
+        super().__init__(capacity_per_device, voter_capacity, mesh)
+
+    def local_slots(self) -> tuple[int, int]:
+        """The global slot interval [start, stop) this process owns."""
+        return (
+            self._dev_lo * self.local_capacity,
+            self._dev_hi * self.local_capacity,
+        )
+
+    # ── Process-local materialization ─────────────────────────────────
+
+    def _init_device_arrays(self) -> None:
+        """Initial pool arrays built from process-local sections (a plain
+        device_put cannot target other hosts' devices)."""
+        from ..ops.decide import STATE_FREE
+
+        p, v = self.capacity, self.voter_capacity
+        self._state = self._put_batch(np.full(p, STATE_FREE, np.int32))
+        self._yes = self._put_batch(np.zeros(p, np.int32))
+        self._tot = self._put_batch(np.zeros(p, np.int32))
+        self._vote_mask = self._put_batch(np.zeros((p, v), bool))
+        self._vote_val = self._put_batch(np.zeros((p, v), bool))
+        self._n = self._put_batch(np.zeros(p, np.int32))
+        self._req = self._put_batch(np.zeros(p, np.int32))
+        self._cap = self._put_batch(np.zeros(p, np.int32))
+        self._gossip = self._put_batch(np.zeros(p, bool))
+        self._liveness = self._put_batch(np.zeros(p, bool))
+
+    def _put_batch(self, arr: np.ndarray) -> jax.Array:
+        """Build the global [D*B, ...] device array from this process's
+        section only — no host ever materializes another host's rows on
+        device (`jax.make_array_from_process_local_data`)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(self.axis) if arr.ndim == 1 else P(self.axis, None)
+        sharding = NamedSharding(self.mesh, spec)
+        rows_per_dev = arr.shape[0] // self.n_devices
+        lo = self._dev_lo * rows_per_dev
+        hi = self._dev_hi * rows_per_dev
+        return jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(arr[lo:hi]), arr.shape
+        )
+
+    @staticmethod
+    def _local_block(garr) -> np.ndarray:
+        """Assemble this process's contiguous section of a 1-D-sharded
+        global array from its addressable shards (device order)."""
+        shards = sorted(
+            garr.addressable_shards,
+            key=lambda s: s.index[0].start if s.index[0].start is not None else 0,
+        )
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+    # ── Data plane ─────────────────────────────────────────────────────
+
+    def ingest_async(self, slots, lanes, values, now):
+        """Collective dispatch; ``slots`` must all be process-local. Unlike
+        the single-host pools an EMPTY batch still dispatches (the other
+        processes' batches are part of the same global program)."""
+        from ..ops.ingest import group_batch, pack_grid, pack_slots
+
+        slots = np.asarray(slots, np.int64)
+        lo, hi = self.local_slots()
+        if slots.size and not ((slots >= lo) & (slots < hi)).all():
+            raise ValueError(
+                f"ingest batch contains non-local slots (this process owns "
+                f"[{lo}, {hi})); route votes to the owning host first"
+            )
+        uniq, row, col, depth = group_batch(slots)
+        s_count = len(uniq)
+        voter_grid = np.zeros((s_count, max(depth, 1)), np.int32)
+        valbit = np.zeros((s_count, max(depth, 1)), np.int32)
+        if slots.size:
+            voter_grid[row, col] = np.asarray(lanes, np.int32)
+            valbit[row, col] = np.asarray(values, np.int32) | 2
+        grid = pack_grid(voter_grid, valbit & 1, valbit >> 1)
+        expired = self._expiry_host[uniq] <= now
+
+        out, row_select = self._dispatch_ingest(
+            pack_slots(uniq.astype(np.int32), expired), grid
+        )
+        from ..engine.pool import PendingIngest
+
+        pending = PendingIngest(
+            out=out, uniq=uniq, row=row, col=col, row_select=row_select
+        )
+        self._inflight.append(pending)
+        return pending
+
+    def _dispatch_ingest(self, slot_pack, grid_pack):
+        from ..engine.pool import _bucket, _pad2, _pad_slot_ids
+        from ..ops.ingest import pack_slots, unpack_slots
+
+        s_count, depth = grid_pack.shape
+        # Agree on padded shapes across processes: every process must
+        # compile and run the same global program.
+        local_shape = np.array(
+            [_bucket(s_count), _bucket(depth, floor=1)], np.int64
+        )
+        agreed = multihost_utils.process_allgather(local_shape)
+        bucket_s = int(agreed[..., 0].max())
+        bucket_l = int(agreed[..., 1].max())
+
+        slots_g, expired = unpack_slots(slot_pack)
+        local_pack = pack_slots(
+            (slots_g % self.local_capacity).astype(np.int32), expired
+        )
+        _, (pack_g, grid_g), rows, bucket = self._route(
+            slots_g.astype(np.int64),
+            [
+                (local_pack, self.local_capacity),
+                (_pad2(grid_pack, s_count, bucket_l, np.int32), 0),
+            ],
+            bucket=bucket_s,
+        )
+        (
+            self._state, self._yes, self._tot, self._vote_mask,
+            self._vote_val, out,
+        ) = self._sharded_ingest(
+            self._state, self._yes, self._tot, self._vote_mask,
+            self._vote_val, self._n, self._req, self._cap,
+            self._gossip, self._liveness,
+            self._put_batch(pack_g),
+            self._put_batch(grid_g),
+        )
+        # Return row positions relative to this process's local block.
+        return out, rows - self._dev_lo * bucket
+
+    def complete_all(self, pendings):
+        """Block on in-flight ingests, pulling only addressable shards
+        (one device_get for all of them)."""
+        shard_lists = []
+        for pending in pendings:
+            shards = sorted(
+                pending.out.addressable_shards,
+                key=lambda s: s.index[0].start
+                if s.index[0].start is not None
+                else 0,
+            )
+            shard_lists.append([s.data for s in shards])
+        flat = jax.device_get([d for lst in shard_lists for d in lst])
+        outs = []
+        pos = 0
+        for lst in shard_lists:
+            outs.append(np.concatenate(flat[pos : pos + len(lst)], axis=0))
+            pos += len(lst)
+        return [
+            self._finish(pending, out) for pending, out in zip(pendings, outs)
+        ]
+
+    def complete(self, pending):
+        return self.complete_all([pending])[0]
+
+    # ── Control plane ──────────────────────────────────────────────────
+
+    def timeout(self, slots):
+        """Collective (identical ``slots`` everywhere); returns and
+        mirror-updates only this process's slots — the owner emits the
+        events."""
+        if not slots:
+            return []
+        self._check_no_inflight("timeout")
+        slot_arr = np.asarray(slots, np.int64)
+        slot_grid, _, rows, bucket = self._route(slot_arr, [])
+        self._state, row_state = self._sharded_timeout(
+            self._state, self._yes, self._tot, self._n, self._req,
+            self._liveness, self._put_batch(slot_grid),
+        )
+        local_block = self._local_block(row_state)
+        lo_rows = self._dev_lo * bucket
+        hi_rows = self._dev_hi * bucket
+        out = []
+        for i, slot in enumerate(slots):
+            r = int(rows[i])
+            if lo_rows <= r < hi_rows:
+                new_state = int(local_block[r - lo_rows])
+                self._state_host[slot] = new_state
+                out.append((int(slot), new_state))
+        return out

@@ -170,18 +170,24 @@ class ShardedPool(ProposalPool):
     # ── Host-side routing ──────────────────────────────────────────────
 
     def _route(
-        self, slots: np.ndarray, payloads: list[tuple[np.ndarray, object]]
+        self,
+        slots: np.ndarray,
+        payloads: list[tuple[np.ndarray, object]],
+        bucket: int | None = None,
     ) -> tuple[np.ndarray, list[np.ndarray], np.ndarray, int]:
         """Distribute per-slot work to the owning devices.
 
         Returns (slot_grid [D*B] of local ids with per-device sentinel,
         routed payload arrays [D*B, ...], flat positions [K] mapping input
-        order -> routed row, bucket B).
+        order -> routed row, bucket B). ``bucket`` overrides the local
+        per-device row bucket (multi-host callers pass the fleet-agreed
+        value so every process compiles the same shapes).
         """
         dev = slots // self.local_capacity
         local = (slots % self.local_capacity).astype(np.int32)
         counts = np.bincount(dev, minlength=self.n_devices)
-        bucket = _bucket(int(counts.max()))
+        if bucket is None:
+            bucket = _bucket(int(counts.max()) if len(slots) else 0)
         order = np.argsort(dev, kind="stable")
         within = np.empty(len(slots), np.int64)
         starts = np.cumsum(counts) - counts

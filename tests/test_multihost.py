@@ -1,0 +1,133 @@
+"""2-process jax.distributed integration: the MultiHostPool end-to-end.
+
+Spawns two real Python processes, each contributing 2 virtual CPU devices
+to one 4-device mesh, and drives the full multi-host contract: replicated
+control plane (allocate/timeout), process-local vote ingest with agreed
+grid shapes, psum global stats, and owner-only transition reporting. This
+is the distributed-communication-backend check from SURVEY §2.3 — DCN-free
+vote routing with consensus state sharded across hosts."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+process_id = int(sys.argv[1])
+coordinator = sys.argv[2]
+
+jax.distributed.initialize(
+    coordinator_address=coordinator, num_processes=2, process_id=process_id
+)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, len(jax.devices())
+assert len(jax.local_devices()) == 2
+
+sys.path.insert(0, os.getcwd())  # spawned with cwd = repo root
+from hashgraph_tpu.ops.decide import (
+    STATE_ACTIVE,
+    STATE_REACHED_NO,
+    STATE_REACHED_YES,
+    required_votes_np,
+)
+from hashgraph_tpu.parallel import MultiHostPool, distributed_consensus_mesh
+
+NOW = 1_700_000_000
+mesh = distributed_consensus_mesh()
+pool = MultiHostPool(capacity_per_device=4, voter_capacity=8, mesh=mesh)
+assert pool.capacity == 16
+lo, hi = pool.local_slots()
+assert (lo, hi) == ((0, 8) if process_id == 0 else (8, 16)), (lo, hi)
+
+# Control plane: REPLICATED — identical allocation on both processes.
+# 8 proposals, round-robin across the 4 devices: slots 0,4,8,12,1,5,9,13.
+P = 8
+slots = pool.allocate_batch(
+    keys=[("s", i) for i in range(P)],
+    n=np.full(P, 3),
+    req=required_votes_np(np.full(P, 3), 2.0 / 3.0),
+    cap=np.full(P, 2),
+    gossip=np.ones(P, bool),
+    liveness=np.full(P, True),
+    expiry=np.array([NOW + (10_000 if i % 2 == 0 else 10) for i in range(P)]),
+    created_at=np.full(P, NOW),
+)
+assert slots == [0, 4, 8, 12, 1, 5, 9, 13], slots
+
+# Data plane: each process ingests votes ONLY for its own slots; cadence
+# is collective (both processes dispatch twice).
+mine = [s for s in slots if lo <= s < hi]
+assert len(mine) == 4
+statuses_seen = []
+for lane in range(2):
+    batch_slots = np.array(mine, np.int64)
+    lanes = np.full(4, lane, np.int32)
+    values = np.ones(4, bool)  # 2 YES of n=3 -> quorum 2 -> REACHED_YES
+    pending = pool.ingest_async(batch_slots, lanes, values, NOW)
+    statuses, transitions = pool.complete(pending)
+    statuses_seen.append(list(statuses))
+assert statuses_seen[0] == [0, 0, 0, 0], statuses_seen
+assert statuses_seen[1] == [0, 0, 0, 0], statuses_seen
+# Second lane decided every local session; transitions are local-only.
+assert {s for s, _ in transitions} == set(mine)
+assert all(st == STATE_REACHED_YES for _, st in transitions)
+
+# Global stats via psum: every process sees the fleet-wide histogram.
+counts = pool.global_state_counts()
+assert counts[STATE_REACHED_YES] == 8, counts
+assert counts[STATE_ACTIVE] == 0, counts
+
+# Empty collective dispatch: process 1 has nothing this round but still
+# participates (process 0 votes NO on nothing — both empty keeps it easy).
+pending = pool.ingest_async(np.empty(0, np.int64), np.empty(0, np.int32), np.empty(0, bool), NOW)
+st, tr = pool.complete(pending)
+assert len(st) == 0 and tr == []
+
+# Timeout sweep: REPLICATED args; each process gets back only its slots.
+swept = pool.timeout(slots)
+assert {s for s, _ in swept} == set(mine), swept
+assert all(st == STATE_REACHED_YES for _, st in swept)  # idempotent: stays decided
+
+print(f"MULTIHOST_OK p{process_id} slots={mine}")
+"""
+
+
+def test_two_process_multihost_pool(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), coordinator],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=repo,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for proc in procs:
+        out, _ = proc.communicate(timeout=220)
+        outs.append(out)
+    for i, (proc, out) in enumerate(zip(procs, outs)):
+        assert proc.returncode == 0, f"process {i} failed:\n{out}"
+        assert f"MULTIHOST_OK p{i}" in out, out
