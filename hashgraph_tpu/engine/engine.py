@@ -47,6 +47,7 @@ from ..ops.decide import (
 from ..protocol import (
     build_vote,
     calculate_consensus_result,
+    regenerate_until_unique,
     validate_proposal_timestamp,
     validate_vote,
 )
@@ -191,6 +192,7 @@ class TpuConsensusEngine(Generic[Scope]):
         """Create a local proposal and claim a pool slot
         (reference: src/service.rs:183-209)."""
         proposal = request.into_proposal(now)
+        self._ensure_unique_pid(scope, proposal)
         # Same gauntlet the scalar service runs via from_proposal ->
         # validate_proposal (trivial for a fresh, vote-free proposal but
         # keeps the error surface identical, reference: src/utils.rs:106-120).
@@ -198,6 +200,21 @@ class TpuConsensusEngine(Generic[Scope]):
         resolved = self._resolve_config(scope, config, proposal)
         self._register(scope, proposal, resolved, now)
         return proposal.clone()
+
+    def _ensure_unique_pid(
+        self, scope: Scope, proposal: Proposal, taken: set[int] | None = None
+    ) -> None:
+        """Collision-proof a locally-generated proposal id against live
+        sessions in this scope and (for batch creation) earlier proposals in
+        the same batch. Policy and rationale: protocol.regenerate_until_unique.
+        """
+        collisions = regenerate_until_unique(
+            proposal,
+            lambda pid: (scope, pid) in self._index
+            or (taken is not None and pid in taken),
+        )
+        if collisions:
+            self.tracer.count("engine.pid_collisions", collisions)
 
     def create_proposals(
         self,
@@ -227,8 +244,11 @@ class TpuConsensusEngine(Generic[Scope]):
 
         proposals: list[Proposal] = []
         configs: list[ConsensusConfig] = []
+        batch_pids: set[int] = set()
         for request in requests:
             proposal = request.into_proposal(now)
+            self._ensure_unique_pid(scope, proposal, taken=batch_pids)
+            batch_pids.add(proposal.proposal_id)
             validate_proposal_timestamp(proposal.expiration_timestamp, now)
             proposals.append(proposal)
             configs.append(self._resolve_config(scope, config, proposal))

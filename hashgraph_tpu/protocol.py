@@ -53,6 +53,24 @@ def generate_id() -> int:
     return fold_u128_to_u32(uuid.uuid4().int)
 
 
+def regenerate_until_unique(proposal, is_taken) -> int:
+    """Regenerate a locally-generated proposal id while ``is_taken(pid)``.
+
+    u32 ids birthday-collide at realistic populations (~1.2% per 10k-proposal
+    wave); the reference's HashMap insert silently overwrites the incumbent
+    session (reference: src/storage.rs:225-230). Regenerating before the
+    fresh (vote-free) proposal becomes visible is semantically free and
+    strictly safer than overwrite. Incoming network proposals must NOT be
+    rewritten — their id is signed into vote chains — so their paths raise
+    ProposalAlreadyExist instead. Returns the number of collisions resolved.
+    """
+    collisions = 0
+    while is_taken(proposal.proposal_id):
+        collisions += 1
+        proposal.proposal_id = generate_id()
+    return collisions
+
+
 def compute_vote_hash(vote: Vote) -> bytes:
     """SHA-256 over the vote's identifying fields in a fixed byte order
     (reference: src/utils.rs:37-47). The signature field is excluded."""

@@ -22,7 +22,13 @@ from .errors import (
     UserAlreadyVoted,
 )
 from .events import BroadcastEventBus, ConsensusEventBus
-from .protocol import build_vote, calculate_consensus_result, validate_proposal_timestamp, validate_vote
+from .protocol import (
+    build_vote,
+    calculate_consensus_result,
+    regenerate_until_unique,
+    validate_proposal_timestamp,
+    validate_vote,
+)
 from .scope_config import NetworkType, ScopeConfig, ScopeConfigBuilder
 from .session import ConsensusConfig, ConsensusSession, ConsensusState
 from .signing import ConsensusSignatureScheme, EthereumConsensusSigner
@@ -146,6 +152,10 @@ class ConsensusService(Generic[Scope]):
     ) -> Proposal:
         """reference: src/service.rs:195-209"""
         proposal = request.into_proposal(now)
+        regenerate_until_unique(
+            proposal,
+            lambda pid: self._storage.get_session(scope, pid) is not None,
+        )
         resolved = self._resolve_config(scope, config, proposal)
         session, _ = ConsensusSession.from_proposal(
             proposal.clone(), self._scheme, resolved, now
