@@ -46,7 +46,6 @@ from ..ops.decide import (
 )
 from ..protocol import (
     build_vote,
-    calculate_consensus_result,
     regenerate_until_unique,
     validate_proposal_timestamp,
     validate_vote,
@@ -508,7 +507,14 @@ class TpuConsensusEngine(Generic[Scope]):
             proposal.expected_voters_count <= self._pool.voter_capacity
             and (
                 session is None
-                or len(session.votes) <= self._pool.voter_capacity
+                or (
+                    len(session.votes) <= self._pool.voter_capacity
+                    # Tally-carrying sessions (columnar spill survivors) stay
+                    # host-backed: pooling would bake tallies into the dense
+                    # row but drop them from the exportable session, so a
+                    # save->load->save round-trip would lose them.
+                    and not session.tallies
+                )
             )
             and self._pool.free_slots > 0
         )
@@ -569,7 +575,10 @@ class TpuConsensusEngine(Generic[Scope]):
         (reference: src/service.rs:216-237)."""
         record = self._get_record(scope, proposal_id)
         validate_proposal_timestamp(record.proposal.expiration_timestamp, now)
-        if self._signer.identity() in record.votes:
+        identity = self._signer.identity()
+        if identity in record.votes or (
+            record.session is not None and identity in record.session.tallies
+        ):
             raise UserAlreadyVoted()
         vote = build_vote(record.proposal, choice, self._signer, now)
         statuses = self.ingest_votes(
@@ -810,24 +819,26 @@ class TpuConsensusEngine(Generic[Scope]):
             found = np.zeros(batch, bool)
             slots = np.zeros(batch, np.int64)
 
-        # Host-spilled sessions (negative slots): rare scalar fallback.
+        # Gids must be interned identities (voter_gid): an out-of-range gid
+        # gets a typed per-row status on BOTH substrates — previously the
+        # spill path raised IndexError mid-batch while the device path
+        # silently accepted any integer as a fresh voter.
+        bad_gid = (voter_gids < 0) | (voter_gids >= self._pool.voter_gid_count)
+        if bad_gid.any():
+            statuses[found & bad_gid] = int(StatusCode.EMPTY_VOTE_OWNER)
+            found = found & ~bad_gid
+
+        # Host-spilled sessions (negative slots): rare scalar fallback,
+        # applied tally-only — fabricating unsigned Vote objects here would
+        # poison the session's exportable chain (advisor r2 medium).
         host_rows = np.nonzero(found & (slots < 0))[0]
         for i in host_rows:
             record = self._records[int(slots[i])]
             owner = self._pool.owner_of_gid(int(voter_gids[i]))
-            vote = Vote(
-                vote_id=0,
-                vote_owner=owner,
-                proposal_id=int(proposal_ids[i]),
-                timestamp=now,
-                vote=bool(values[i]),
-                parent_hash=b"",
-                received_hash=b"",
-                vote_hash=b"columnar",
-                signature=b"columnar",
-            )
             was_active = record.session.state.is_active
-            code, event = self._host_add_vote(record, vote, now)
+            code, event = self._host_add_tally(
+                record, owner, bool(values[i]), now
+            )
             statuses[i] = code
             self.tracer.count(
                 "engine.votes_accepted", int(code == int(StatusCode.OK))
@@ -965,10 +976,29 @@ class TpuConsensusEngine(Generic[Scope]):
         Returns (status code, event-to-emit-or-None); the caller queues the
         event so emission order follows per-vote arrival order even when a
         batch mixes substrates."""
+        return self._host_apply(record, lambda s: s.add_vote(vote, now), now)
+
+    def _host_add_tally(
+        self, record: SessionRecord[Scope], owner: bytes, value: bool, now: int
+    ) -> tuple[int, ConsensusEvent | None]:
+        """Columnar counterpart of _host_add_vote: apply one tally to a
+        host-spilled session (session.add_tally — no Vote object is
+        fabricated, so the session's exportable chain stays valid)."""
+        return self._host_apply(
+            record, lambda s: s.add_tally(owner, value, now), now
+        )
+
+    def _host_apply(
+        self, record: SessionRecord[Scope], mutate, now: int
+    ) -> tuple[int, ConsensusEvent | None]:
+        """Shared outcome mapping for host-spilled mutations: run the
+        session mutation, translate scalar outcomes to the device path's
+        status codes, and surface the transition event (if any) for the
+        caller to queue in arrival order."""
         session = record.session
         already = session.state.is_reached
         try:
-            transition = session.add_vote(vote, now)
+            transition = mutate(session)
         except ConsensusError as exc:
             return int(exc.code), None
         event = None
@@ -990,13 +1020,7 @@ class TpuConsensusEngine(Generic[Scope]):
         sessions, Failed sessions stay Failed."""
         session = record.session
         if session.state.is_active:
-            result = calculate_consensus_result(
-                session.votes,
-                session.proposal.expected_voters_count,
-                session.config.consensus_threshold,
-                session.proposal.liveness_criteria_yes,
-                True,
-            )
+            result = session.decide_now(True)
             session.state = (
                 ConsensusState.reached(result)
                 if result is not None

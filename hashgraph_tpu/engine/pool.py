@@ -269,6 +269,12 @@ class ProposalPool:
     def owner_of_gid(self, gid: int) -> bytes:
         return self._owners[gid]
 
+    @property
+    def voter_gid_count(self) -> int:
+        """Number of interned voter identities; valid gids are
+        [0, voter_gid_count)."""
+        return len(self._owners)
+
     def clear_voter_registry(self) -> None:
         """Reset the owner↔gid interning tables.
 
@@ -335,7 +341,9 @@ class ProposalPool:
             return lanes
         # One key per unseen (slot, gid); np.unique gives the first flat
         # occurrence of each, and within-slot arrival rank = lane offset.
-        keys = (slots[rem] << 32) | gids32[rem].astype(np.int64)
+        # Mask the gid to its unsigned 32-bit pattern: without it a gid
+        # >= 2^31 sign-extends and corrupts the slot bits of the key.
+        keys = (slots[rem] << 32) | (gids32[rem].astype(np.int64) & 0xFFFFFFFF)
         uniq_keys, first_pos, inverse = np.unique(
             keys, return_index=True, return_inverse=True
         )

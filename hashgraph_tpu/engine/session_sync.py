@@ -70,22 +70,28 @@ def load_session_rows(
     error (engine: reject the proposal) or a degrade-to-host condition
     (storage: release the slot)."""
     vcap = pool.voter_capacity
-    if len(session.votes) > vcap:
+    total = len(session.votes) + len(session.tallies)
+    if total > vcap:
         return False
     mask = np.zeros((1, vcap), bool)
     vals = np.zeros((1, vcap), bool)
-    for owner, vote in session.votes.items():
+    # Votes and columnar tallies (owner -> bool, no Vote object) project
+    # onto lanes identically — each owner holds exactly one of the two.
+    participants = [(o, v.vote) for o, v in session.votes.items()] + list(
+        session.tallies.items()
+    )
+    for owner, value in participants:
         lane = pool.lane_for(slot, owner)
         if lane is None:
             return False
         mask[0, lane] = True
-        vals[0, lane] = vote.vote
-    yes = sum(1 for v in session.votes.values() if v.vote)
+        vals[0, lane] = value
+    yes = sum(1 for _, value in participants if value)
     pool.load_rows(
         [slot],
         state=np.array([state_code_of(session.state)]),
         yes=np.array([yes]),
-        tot=np.array([len(session.votes)]),
+        tot=np.array([total]),
         mask_rows=mask,
         val_rows=vals,
     )

@@ -276,9 +276,11 @@ class MultiHostPool(ShardedPool):
     # ── Control plane ──────────────────────────────────────────────────
 
     def timeout(self, slots):
-        """Collective (identical ``slots`` everywhere); returns and
-        mirror-updates only this process's slots — the owner emits the
-        events."""
+        """Collective (identical ``slots`` everywhere); returns only this
+        process's slots — the owner emits the events. The host state mirror
+        is synced for ALL requested slots (one small allgather), so
+        ``state_of``/``state_counts`` — and any engine layered on top — stay
+        truthful for non-local slots after a sweep."""
         if not slots:
             return []
         self._check_no_inflight("timeout")
@@ -291,11 +293,41 @@ class MultiHostPool(ShardedPool):
         local_block = self._local_block(row_state)
         lo_rows = self._dev_lo * bucket
         hi_rows = self._dev_hi * bucket
+        local_states = np.full(len(slots), -1, np.int64)
         out = []
         for i, slot in enumerate(slots):
             r = int(rows[i])
             if lo_rows <= r < hi_rows:
                 new_state = int(local_block[r - lo_rows])
-                self._state_host[slot] = new_state
+                local_states[i] = new_state
                 out.append((int(slot), new_state))
+        # Every slot is local to exactly one process; max over the gathered
+        # per-process vectors (-1 where non-local) recovers each slot's
+        # owner-observed state on every process.
+        gathered = multihost_utils.process_allgather(local_states)
+        global_states = np.asarray(gathered).reshape(-1, len(slots)).max(axis=0)
+        self._state_host[slot_arr] = global_states.astype(np.int32)
         return out
+
+    def sync_states(self) -> None:
+        """Refresh the host state mirror for non-local slots.
+
+        Ingest transitions are observed owner-locally by design (zero DCN on
+        the hot path), so remote slots' mirrored states lag until the next
+        collective touch. This collective (identical cadence on every
+        process; requires homogeneous per-process device counts) allgathers
+        each process's local mirror block so ``state_of``/``state_counts``
+        are globally exact at a quiesce/stats point."""
+        self._check_no_inflight("sync_states")
+        lo, hi = self.local_slots()
+        gathered = np.asarray(
+            multihost_utils.process_allgather(
+                np.concatenate(
+                    [np.array([lo], np.int64), self._state_host[lo:hi].astype(np.int64)]
+                )
+            )
+        ).reshape(jax.process_count(), -1)
+        for row in gathered:
+            start = int(row[0])
+            block = row[1:].astype(np.int32)
+            self._state_host[start : start + len(block)] = block

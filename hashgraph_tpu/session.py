@@ -20,8 +20,8 @@ from .errors import (
 )
 from .protocol import (
     COMPUTE_CHAIN,
-    calculate_consensus_result,
     calculate_max_rounds,
+    decide,
     validate_proposal,
     validate_proposal_timestamp,
     validate_threshold,
@@ -159,13 +159,20 @@ class ConsensusState:
 
 @dataclass
 class ConsensusSession:
-    """Per-proposal lifecycle tracker (reference: src/session.rs:166-178)."""
+    """Per-proposal lifecycle tracker (reference: src/session.rs:166-178).
+
+    ``tallies`` is TPU-framework-specific: owner -> yes/no records applied
+    through the columnar path (:meth:`add_tally`), which deliberately
+    carries no Vote objects. They count toward decisions and duplicate
+    detection exactly like votes, but are absent from the proposal's
+    embedded chain — the documented columnar trade-off (PARITY.md)."""
 
     proposal: Proposal
     state: ConsensusState
     votes: dict[bytes, Vote]  # vote_owner -> Vote, one vote per participant
     created_at: int
     config: ConsensusConfig
+    tallies: dict[bytes, bool] = field(default_factory=dict)
 
     def clone(self) -> "ConsensusSession":
         return ConsensusSession(
@@ -174,6 +181,7 @@ class ConsensusSession:
             votes={k: v.clone() for k, v in self.votes.items()},
             created_at=self.created_at,
             config=self.config,
+            tallies=dict(self.tallies),
         )
 
     @classmethod
@@ -233,10 +241,30 @@ class ConsensusSession:
 
         validate_proposal_timestamp(self.proposal.expiration_timestamp, now)
         self._check_round_limit(1)
-        if vote.vote_owner in self.votes:
+        if vote.vote_owner in self.votes or vote.vote_owner in self.tallies:
             raise DuplicateVote()
         self.votes[vote.vote_owner] = vote.clone()
         self.proposal.votes.append(vote.clone())
+        self._update_round(1)
+        return self._check_consensus()
+
+    def add_tally(self, owner: bytes, value: bool, now: int) -> SessionTransition:
+        """Columnar analogue of :meth:`add_vote`: record one validated
+        yes/no choice for an owner WITHOUT materializing a Vote object or
+        touching the proposal's embedded chain. Same check order, round
+        bookkeeping, and decision semantics as add_vote — this is what the
+        device pool does per lane, expressed on the scalar substrate (used
+        for host-spilled sessions on the columnar ingest path)."""
+        if self.state.is_reached:
+            return SessionTransition.consensus_reached(self.state.result)
+        if not self.state.is_active:
+            raise SessionNotActive()
+
+        validate_proposal_timestamp(self.proposal.expiration_timestamp, now)
+        self._check_round_limit(1)
+        if owner in self.votes or owner in self.tallies:
+            raise DuplicateVote()
+        self.tallies[owner] = value
         self._update_round(1)
         return self._check_consensus()
 
@@ -324,16 +352,26 @@ class ConsensusSession:
         else:
             self.proposal.round = min(self.proposal.round + vote_count, _U32_MAX)
 
-    def _check_consensus(self) -> SessionTransition:
-        """Run the decision kernel with is_timeout=False
-        (reference: src/session.rs:372-387)."""
-        result = calculate_consensus_result(
-            self.votes,
+    def decide_now(self, is_timeout: bool) -> bool | None:
+        """Run the decision kernel over votes + columnar tallies (the
+        combined participant set — each owner appears in exactly one)."""
+        yes = sum(1 for v in self.votes.values() if v.vote) + sum(
+            1 for t in self.tallies.values() if t
+        )
+        total = len(self.votes) + len(self.tallies)
+        return decide(
+            yes,
+            total,
             self.proposal.expected_voters_count,
             self.config.consensus_threshold,
             self.proposal.liveness_criteria_yes,
-            False,
+            is_timeout,
         )
+
+    def _check_consensus(self) -> SessionTransition:
+        """Run the decision kernel with is_timeout=False
+        (reference: src/session.rs:372-387)."""
+        result = self.decide_now(False)
         if result is not None:
             self.state = ConsensusState.reached(result)
             return SessionTransition.consensus_reached(result)

@@ -241,6 +241,153 @@ class TestColumnarIngestParity:
         assert all(e.result is True for _, e in events)
 
 
+class TestColumnarSpillIntegrity:
+    """Advisor r2 medium: the columnar spill path must not fabricate
+    unsigned Vote objects — a peer replaying the exported proposal would
+    reject the whole chain."""
+
+    def test_spilled_columnar_votes_are_tally_only(self):
+        engine = make_engine(voter_capacity=2)
+        # n=8 > 2 lanes: host-spilled.
+        [p] = engine.create_proposals("s", [request(n=8, name="x")], NOW)
+        owners = [bytes([10 + i]) * 20 for i in range(6)]
+        gids = np.array([engine.voter_gid(o) for o in owners], np.int64)
+        st = engine.ingest_columnar(
+            "s",
+            np.full(6, p.proposal_id, np.int64),
+            gids,
+            np.ones(6, bool),
+            NOW,
+        )
+        assert list(st) == [int(StatusCode.OK)] * 6
+        # No synthetic Vote objects anywhere observable.
+        exported = engine.export_session("s", p.proposal_id)
+        assert exported.proposal.votes == []
+        assert exported.votes == {}
+        assert dict(exported.tallies) == {o: True for o in owners}
+        assert engine.get_proposal("s", p.proposal_id).votes == []
+        # The tallies counted: 6 yes + 2 liveness-yes silents clears the
+        # ceil(2*8/3)=6 bar, so the session decided on the tallies alone.
+        assert engine.get_consensus_result("s", p.proposal_id) is True
+        more = [bytes([30 + i]) * 20 for i in range(2)]
+        st2 = engine.ingest_columnar(
+            "s",
+            np.full(2, p.proposal_id, np.int64),
+            np.array([engine.voter_gid(o) for o in more], np.int64),
+            np.ones(2, bool),
+            NOW,
+        )
+        assert list(st2) == [int(StatusCode.ALREADY_REACHED)] * 2
+
+    def test_spilled_exported_proposal_regossips_cleanly(self):
+        """A proposal exported after columnar spill ingest must pass a peer
+        engine's full validation gauntlet (empty chain == valid chain)."""
+        engine = make_engine(voter_capacity=2)
+        [p] = engine.create_proposals("s", [request(n=8, name="x")], NOW)
+        gid = engine.voter_gid(b"\x07" * 20)
+        engine.ingest_columnar(
+            "s",
+            np.array([p.proposal_id], np.int64),
+            np.array([gid], np.int64),
+            np.array([True], bool),
+            NOW,
+        )
+        exported = engine.get_proposal("s", p.proposal_id)
+        peer = make_engine()
+        peer.process_incoming_proposal("s", exported, NOW)  # must not raise
+
+    def test_columnar_tally_and_scalar_vote_dedup_each_other(self):
+        engine = make_engine(voter_capacity=2)
+        [p] = engine.create_proposals("s", [request(n=8, name="x")], NOW)
+        owner = b"\x09" * 20
+        gid = engine.voter_gid(owner)
+        st = engine.ingest_columnar(
+            "s",
+            np.array([p.proposal_id], np.int64),
+            np.array([gid], np.int64),
+            np.array([True], bool),
+            NOW,
+        )
+        assert st[0] == int(StatusCode.OK)
+        # The same owner voting through the scalar path is a duplicate.
+        from hashgraph_tpu.wire import Vote
+
+        vote = Vote(
+            vote_id=1,
+            vote_owner=owner,
+            proposal_id=p.proposal_id,
+            timestamp=NOW,
+            vote=True,
+            parent_hash=b"",
+            received_hash=b"",
+            vote_hash=b"h",
+            signature=b"s",
+        )
+        st2 = engine.ingest_votes([("s", vote)], NOW, pre_validated=True)
+        assert st2[0] == int(StatusCode.DUPLICATE_VOTE)
+
+    def test_uninterned_gid_typed_status_both_substrates(self):
+        """Advisor r2 low: an un-interned gid must produce a per-row typed
+        status, not an IndexError (spill) or a silent fresh voter (device)."""
+        engine = make_engine(voter_capacity=2)
+        pooled, spilled = engine.create_proposals(
+            "s", [request(n=2, name="a"), request(n=8, name="b")], NOW
+        )
+        good = engine.voter_gid(b"\x01" * 20)
+        st = engine.ingest_columnar(
+            "s",
+            np.array(
+                [pooled.proposal_id, spilled.proposal_id] * 2, np.int64
+            ),
+            np.array([good, good, 999, -1], np.int64),
+            np.ones(4, bool),
+            NOW,
+        )
+        assert list(st[:2]) == [int(StatusCode.OK)] * 2
+        assert list(st[2:]) == [int(StatusCode.EMPTY_VOTE_OWNER)] * 2
+
+    def test_cast_vote_after_own_columnar_tally_raises_user_already_voted(self):
+        from hashgraph_tpu import UserAlreadyVoted
+
+        engine = make_engine(voter_capacity=2)
+        [p] = engine.create_proposals("s", [request(n=8, name="x")], NOW)
+        gid = engine.voter_gid(engine.signer().identity())
+        st = engine.ingest_columnar(
+            "s",
+            np.array([p.proposal_id], np.int64),
+            np.array([gid], np.int64),
+            np.array([True], bool),
+            NOW,
+        )
+        assert st[0] == int(StatusCode.OK)
+        with pytest.raises(UserAlreadyVoted):
+            engine.cast_vote("s", p.proposal_id, True, NOW)
+
+    def test_checkpoint_roundtrip_preserves_tallies(self):
+        from hashgraph_tpu import InMemoryConsensusStorage
+
+        engine = make_engine(voter_capacity=2)
+        [p] = engine.create_proposals("s", [request(n=8, name="x")], NOW)
+        owners = [bytes([40 + i]) * 20 for i in range(3)]
+        engine.ingest_columnar(
+            "s",
+            np.full(3, p.proposal_id, np.int64),
+            np.array([engine.voter_gid(o) for o in owners], np.int64),
+            np.array([True, False, True], bool),
+            NOW,
+        )
+        storage = InMemoryConsensusStorage()
+        engine.save_to_storage(storage)
+        restored = make_engine(voter_capacity=2)
+        restored.load_from_storage(storage)
+        session = restored.export_session("s", p.proposal_id)
+        assert dict(session.tallies) == {
+            owners[0]: True,
+            owners[1]: False,
+            owners[2]: True,
+        }
+
+
 class TestLaneBatchResolution:
     def test_mixed_existing_and_new(self):
         from hashgraph_tpu.engine import ProposalPool
@@ -269,3 +416,27 @@ class TestLaneBatchResolution:
         # Scalar sees batch assignments.
         assert pool.lane_for(1, bytes([2]) * 4) == 0
         assert pool.lane_for(0, bytes([1]) * 4) == 1
+
+    def test_huge_gid_does_not_corrupt_packed_keys(self):
+        """Advisor r2 low: a gid >= 2^31 must not sign-extend into the slot
+        bits of the (slot << 32) | gid dedup key."""
+        from hashgraph_tpu.engine import ProposalPool
+
+        pool = ProposalPool(4, 3)
+        pool.allocate_batch(
+            keys=["a", "b"],
+            n=np.array([3, 3]),
+            req=np.array([2, 2]),
+            cap=np.array([2, 2]),
+            gossip=np.array([True, True]),
+            liveness=np.array([True, True]),
+            expiry=np.array([100, 100]),
+            created_at=np.array([0, 0]),
+        )
+        big = 2**31 + 5  # int32-wraps to negative
+        lanes = pool.lanes_for_batch(
+            np.array([0, 1, 0]), np.array([big, big, big])
+        )
+        # Same gid: fresh lane per slot, repeat resolves to the same lane.
+        assert list(lanes) == [0, 0, 0]
+        assert pool._lane_count[0] == 1 and pool._lane_count[1] == 1
