@@ -105,6 +105,26 @@ class TestEcdsa:
         assert isinstance(results[2], ConsensusSchemeError)
         assert isinstance(results[3], ConsensusSchemeError)
 
+    def test_glv_recover_stress(self):
+        """256 random keys/payloads through the batch verifier. The recover
+        scalar u2 = s·r⁻¹ mod n is effectively uniform, so this sweeps the
+        GLV split across random scalars; any decomposition bug shows up as a
+        wrong recovered address. Tampered copies must all flip to invalid."""
+        import random
+
+        rng = random.Random(0x61F)
+        keys = [rng.getrandbits(255) | 1 for _ in range(256)]
+        signers = [signer_with_seed(k) for k in keys]
+        payloads = [rng.getrandbits(8 * 24).to_bytes(24, "big") for _ in keys]
+        sigs = [s.sign(p) for s, p in zip(signers, payloads)]
+        ids = [s.identity() for s in signers]
+        res = native.eth_verify_batch(ids, payloads, sigs)
+        assert res.tolist() == [1] * len(keys)
+        # Flip one byte of each signature's r: verify must not return 1.
+        bad = [bytes([sig[0] ^ 0x01]) + sig[1:] for sig in sigs]
+        res_bad = native.eth_verify_batch(ids, payloads, bad)
+        assert all(r in (0, 254) for r in res_bad.tolist())
+
     def test_batch_matches_scalar_loop(self):
         signers = [signer_with_seed(s) for s in range(30, 36)]
         payloads = [os.urandom(40) for _ in signers]
