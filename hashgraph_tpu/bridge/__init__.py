@@ -1,0 +1,11 @@
+"""Embedder/FFI bridge: the consensus surface for non-Python processes.
+
+See :mod:`hashgraph_tpu.bridge.protocol` for the wire format,
+:class:`~hashgraph_tpu.bridge.server.BridgeServer` for the host side, and
+``native/bridge_client.c`` for the C reference embedder.
+"""
+
+from .client import BridgeClient, BridgeError, BridgeEvent
+from .server import BridgeServer
+
+__all__ = ["BridgeClient", "BridgeError", "BridgeEvent", "BridgeServer"]

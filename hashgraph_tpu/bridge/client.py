@@ -1,0 +1,160 @@
+"""Python reference client for the embedder bridge.
+
+Mirrors ``native/bridge_client.c`` one call per opcode; used by the test
+suite and as executable documentation of the wire protocol. An embedder in
+any language reproduces exactly these byte sequences.
+"""
+
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass
+
+from ..errors import StatusCode, error_for_code
+from . import protocol as P
+
+
+class BridgeError(Exception):
+    """Non-OK response from the bridge, carrying the wire status."""
+
+    def __init__(self, status: int, message: str = ""):
+        self.status = status
+        try:
+            name = StatusCode(status).name
+        except ValueError:
+            name = f"bridge status {status}"
+        super().__init__(f"{name}: {message}" if message else name)
+
+
+@dataclass(frozen=True)
+class BridgeEvent:
+    scope: str
+    kind: int  # P.EVENT_REACHED / P.EVENT_FAILED
+    proposal_id: int
+    result: bool
+    timestamp: int
+
+
+class BridgeClient:
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def __enter__(self) -> "BridgeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ── plumbing ───────────────────────────────────────────────────────
+
+    def _call(self, opcode: int, payload: bytes = b"") -> P.Cursor:
+        self._sock.sendall(P.encode_frame(opcode, payload))
+        status, cursor = P.read_frame(self._sock)
+        if status != P.STATUS_OK:
+            message = ""
+            try:
+                message = cursor.string()
+            except ValueError:
+                pass
+            raise BridgeError(status, message)
+        return cursor
+
+    # ── API ────────────────────────────────────────────────────────────
+
+    def ping(self) -> int:
+        return self._call(P.OP_PING).u32()
+
+    def add_peer(self, private_key: bytes | None = None) -> tuple[int, bytes]:
+        """Returns (peer_id, identity bytes)."""
+        key = private_key or b""
+        cursor = self._call(P.OP_ADD_PEER, P.u8(len(key)) + key)
+        peer_id = cursor.u32()
+        identity = cursor.raw(cursor.u8())
+        return peer_id, identity
+
+    def create_proposal(
+        self,
+        peer: int,
+        scope: str,
+        now: int,
+        name: str,
+        payload: bytes,
+        expected_voters: int,
+        rel_expiration: int,
+        liveness_yes: bool = True,
+    ) -> tuple[int, bytes]:
+        """Returns (proposal_id, proposal protobuf bytes)."""
+        cursor = self._call(
+            P.OP_CREATE_PROPOSAL,
+            P.u32(peer)
+            + P.string(scope)
+            + P.u64(now)
+            + P.string(name)
+            + P.blob(payload)
+            + P.u32(expected_voters)
+            + P.u64(rel_expiration)
+            + P.u8(1 if liveness_yes else 0),
+        )
+        return cursor.u32(), cursor.blob()
+
+    def cast_vote(self, peer: int, scope: str, pid: int, choice: bool, now: int) -> bytes:
+        """Returns the signed Vote protobuf bytes for gossiping."""
+        cursor = self._call(
+            P.OP_CAST_VOTE,
+            P.u32(peer) + P.string(scope) + P.u32(pid) + P.u8(1 if choice else 0) + P.u64(now),
+        )
+        return cursor.blob()
+
+    def process_proposal(self, peer: int, scope: str, proposal: bytes, now: int) -> None:
+        self._call(
+            P.OP_PROCESS_PROPOSAL,
+            P.u32(peer) + P.string(scope) + P.u64(now) + P.blob(proposal),
+        )
+
+    def process_vote(self, peer: int, scope: str, vote: bytes, now: int) -> None:
+        self._call(
+            P.OP_PROCESS_VOTE,
+            P.u32(peer) + P.string(scope) + P.u64(now) + P.blob(vote),
+        )
+
+    def handle_timeout(self, peer: int, scope: str, pid: int, now: int) -> bool:
+        cursor = self._call(
+            P.OP_HANDLE_TIMEOUT, P.u32(peer) + P.string(scope) + P.u32(pid) + P.u64(now)
+        )
+        return bool(cursor.u8())
+
+    def get_result(self, peer: int, scope: str, pid: int) -> bool | None:
+        """True/False once decided, None while active; raises on failed."""
+        cursor = self._call(P.OP_GET_RESULT, P.u32(peer) + P.string(scope) + P.u32(pid))
+        value = cursor.u8()
+        if value == P.RESULT_UNDECIDED:
+            return None
+        if value == P.RESULT_FAILED:
+            raise error_for_code(int(StatusCode.CONSENSUS_FAILED))()
+        return value == P.RESULT_YES
+
+    def poll_events(self, peer: int) -> list[BridgeEvent]:
+        cursor = self._call(P.OP_POLL_EVENTS, P.u32(peer))
+        events = []
+        for _ in range(cursor.u32()):
+            scope = cursor.string()
+            kind = cursor.u8()
+            pid = cursor.u32()
+            result = bool(cursor.u8())
+            ts = cursor.u64()
+            events.append(BridgeEvent(scope, kind, pid, result, ts))
+        return events
+
+    def get_proposal(self, peer: int, scope: str, pid: int) -> bytes:
+        return self._call(
+            P.OP_GET_PROPOSAL, P.u32(peer) + P.string(scope) + P.u32(pid)
+        ).blob()
+
+    def get_stats(self, peer: int, scope: str) -> tuple[int, int, int, int]:
+        """(total, active, failed, reached)."""
+        cursor = self._call(P.OP_GET_STATS, P.u32(peer) + P.string(scope))
+        return cursor.u32(), cursor.u32(), cursor.u32(), cursor.u32()

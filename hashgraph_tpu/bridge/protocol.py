@@ -1,0 +1,157 @@
+"""Embedder bridge wire protocol: framing, opcodes, and field codecs.
+
+The reference is a library an application embeds in-process
+(reference: README.md:183-197, src/lib.rs:15-34); its FFI story is "link the
+crate". This framework's compute engine lives in a Python/JAX process, so the
+embedder boundary is a byte protocol instead: any language opens a TCP
+connection to :class:`~hashgraph_tpu.bridge.server.BridgeServer` and drives
+the full ConsensusService surface (create_proposal, cast_vote,
+process_incoming_{proposal,vote}, handle_consensus_timeout, events out) with
+`Proposal`/`Vote` payloads as the exact protobuf bytes of
+``protos/messages/v1/consensus.proto`` — the same bytes the reference's prost
+codec produces, so a Rust embedder can decode them with its own generated
+types. ``native/bridge_client.c`` is the C reference client.
+
+Frame layout (all integers little-endian):
+
+    request:  u32 length | u8 opcode | payload
+    response: u32 length | u8 status | payload
+
+``length`` counts the opcode/status byte plus the payload. Field codecs:
+strings are ``u16 len + UTF-8``; byte blobs are ``u32 len + bytes``. Every
+opcode except PING and ADD_PEER starts its payload with the ``u32 peer_id``
+returned by ADD_PEER (a bridge server hosts many independent peers, mirroring
+the reference's one-service-per-peer deployment, src/service.rs:26-29).
+
+Statuses: 0 = OK; 1..29 mirror :class:`hashgraph_tpu.errors.StatusCode`;
+240+ are bridge-level (unknown peer / malformed frame / unknown opcode /
+internal error). Error responses carry the message as a string payload.
+"""
+
+from __future__ import annotations
+
+import struct
+
+PROTOCOL_VERSION = 1
+
+# Opcodes.
+OP_PING = 0
+OP_ADD_PEER = 1
+OP_CREATE_PROPOSAL = 2
+OP_CAST_VOTE = 3
+OP_PROCESS_PROPOSAL = 4
+OP_PROCESS_VOTE = 5
+OP_HANDLE_TIMEOUT = 6
+OP_GET_RESULT = 7
+OP_POLL_EVENTS = 8
+OP_GET_PROPOSAL = 9
+OP_GET_STATS = 10
+
+# Bridge-level statuses (protocol StatusCode values occupy 0..29).
+STATUS_OK = 0
+STATUS_UNKNOWN_PEER = 240
+STATUS_BAD_REQUEST = 241
+STATUS_UNKNOWN_OPCODE = 242
+STATUS_INTERNAL = 250
+
+# GET_RESULT payload byte.
+RESULT_NO = 0
+RESULT_YES = 1
+RESULT_FAILED = 2
+RESULT_UNDECIDED = 255
+
+# POLL_EVENTS event kinds.
+EVENT_REACHED = 1
+EVENT_FAILED = 2
+
+MAX_FRAME = 64 * 1024 * 1024  # hard cap against garbage length prefixes
+
+
+class Cursor:
+    """Sequential reader over one frame's payload."""
+
+    def __init__(self, data: bytes):
+        self._data = data
+        self._pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self._pos + n > len(self._data):
+            raise ValueError("frame truncated")
+        out = self._data[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+    def raw(self, n: int) -> bytes:
+        return self._take(n)
+
+    def u8(self) -> int:
+        return self._take(1)[0]
+
+    def u16(self) -> int:
+        return struct.unpack("<H", self._take(2))[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self._take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self._take(8))[0]
+
+    def string(self) -> str:
+        return self._take(self.u16()).decode("utf-8")
+
+    def blob(self) -> bytes:
+        return self._take(self.u32())
+
+    def done(self) -> bool:
+        return self._pos == len(self._data)
+
+
+def u8(v: int) -> bytes:
+    return struct.pack("<B", v)
+
+
+def u16(v: int) -> bytes:
+    return struct.pack("<H", v)
+
+
+def u32(v: int) -> bytes:
+    return struct.pack("<I", v)
+
+
+def u64(v: int) -> bytes:
+    return struct.pack("<Q", v)
+
+
+def string(s: str) -> bytes:
+    raw = s.encode("utf-8")
+    return u16(len(raw)) + raw
+
+
+def blob(b: bytes) -> bytes:
+    return u32(len(b)) + b
+
+
+def encode_frame(lead: int, payload: bytes = b"") -> bytes:
+    """``lead`` is the opcode (requests) or status (responses)."""
+    return u32(1 + len(payload)) + u8(lead) + payload
+
+
+def read_exact(sock, n: int) -> bytes:
+    """Read exactly n bytes from a socket; raises ConnectionError on EOF."""
+    chunks = []
+    while n > 0:
+        chunk = sock.recv(n)
+        if not chunk:
+            raise ConnectionError("bridge peer closed the connection")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(sock) -> tuple[int, Cursor]:
+    """Returns (opcode-or-status, payload cursor)."""
+    (length,) = struct.unpack("<I", read_exact(sock, 4))
+    if length < 1 or length > MAX_FRAME:
+        raise ValueError(f"bad frame length {length}")
+    body = read_exact(sock, length)
+    return body[0], Cursor(body[1:])

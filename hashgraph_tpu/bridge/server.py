@@ -1,0 +1,338 @@
+"""Embedder bridge server: the framework's consensus surface over TCP.
+
+One :class:`BridgeServer` hosts many independent *peers*; each peer is a
+:class:`~hashgraph_tpu.engine.TpuConsensusEngine` with its own signer and
+event subscription — the same one-service-per-peer unit the reference
+deploys (reference: src/service.rs:26-29, README.md:120-171). A non-Python
+embedder (see ``native/bridge_client.c``) ferries the protobuf
+``Proposal``/``Vote`` bytes between peers exactly the way the reference's
+host application ferries prost messages between its services
+(reference: README.md:183-197, tests/network_gossip_tests.rs:20-152).
+
+The server binds loopback by default: it is an in-machine FFI boundary, not
+a network service — transport security is the embedder's job, as in the
+reference's no-I/O contract (reference: src/lib.rs:15-34).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from struct import error as struct_error
+
+from ..engine import TpuConsensusEngine
+from ..errors import ConsensusError
+from ..events import BroadcastEventBus, EventReceiver
+from ..signing import ConsensusSignatureScheme
+from ..signing.ethereum import EthereumConsensusSigner
+from ..types import (
+    ConsensusEvent,
+    ConsensusFailedEvent,
+    ConsensusReached,
+    CreateProposalRequest,
+)
+from ..wire import Proposal, Vote
+from . import protocol as P
+
+
+class _Peer:
+    def __init__(self, peer_id: int, engine: TpuConsensusEngine, receiver: EventReceiver):
+        self.peer_id = peer_id
+        self.engine = engine
+        self.receiver = receiver
+
+
+class BridgeServer:
+    """Threaded TCP front-end over per-peer consensus engines.
+
+    ``port=0`` binds an ephemeral port (read it back from :attr:`address`).
+    ``engine_factory(signer)`` swaps the backing engine, e.g. one over a
+    sharded device-mesh pool; the default builds a small single-chip engine
+    per peer.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        capacity: int = 256,
+        voter_capacity: int = 16,
+        engine_factory=None,
+    ):
+        self._host = host
+        self._port = port
+        self._capacity = capacity
+        self._voter_capacity = voter_capacity
+        self._engine_factory = engine_factory
+        self._peers: dict[int, _Peer] = {}
+        self._next_peer = 1
+        self._lock = threading.Lock()
+        self._listener: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._connections: set[socket.socket] = set()
+        self._running = False
+
+    # ── lifecycle ──────────────────────────────────────────────────────
+
+    @property
+    def address(self) -> tuple[str, int]:
+        if self._listener is None:
+            raise RuntimeError("server not started")
+        return self._listener.getsockname()[:2]
+
+    def start(self) -> tuple[str, int]:
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((self._host, self._port))
+        listener.listen(16)
+        self._listener = listener
+        self._running = True
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+        return self.address
+
+    def stop(self) -> None:
+        """Quiesce the bridge: no new connections, live connections closed.
+        After stop() returns no further frames mutate the peer engines."""
+        self._running = False
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            connections = list(self._connections)
+        for conn in connections:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def __enter__(self) -> "BridgeServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ── connection handling ────────────────────────────────────────────
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(
+                target=self._serve_connection, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._connections.add(conn)
+        try:
+            self._serve_frames(conn)
+        finally:
+            with self._lock:
+                self._connections.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_frames(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while self._running:
+            try:
+                opcode, cursor = P.read_frame(conn)
+            except (ConnectionError, OSError):
+                return
+            except ValueError:
+                try:
+                    conn.sendall(P.encode_frame(P.STATUS_BAD_REQUEST))
+                except OSError:
+                    pass
+                return
+            if not self._running:
+                return
+            try:
+                status, payload = self._dispatch(opcode, cursor)
+            except ConsensusError as exc:
+                status, payload = int(exc.code), P.string(str(exc))
+            except (ValueError, KeyError, struct_error) as exc:
+                status, payload = P.STATUS_BAD_REQUEST, P.string(str(exc))
+            except Exception as exc:  # pragma: no cover - defensive
+                status, payload = P.STATUS_INTERNAL, P.string(repr(exc))
+            try:
+                conn.sendall(P.encode_frame(status, payload))
+            except OSError:
+                return
+
+    # ── dispatch ───────────────────────────────────────────────────────
+
+    def _dispatch(self, opcode: int, c: P.Cursor) -> tuple[int, bytes]:
+        if opcode == P.OP_PING:
+            return P.STATUS_OK, P.u32(P.PROTOCOL_VERSION)
+        if opcode == P.OP_ADD_PEER:
+            return self._op_add_peer(c)
+        handler = _HANDLERS.get(opcode)
+        if handler is None:
+            return P.STATUS_UNKNOWN_OPCODE, b""
+        peer = self._peers.get(c.u32())
+        if peer is None:
+            return P.STATUS_UNKNOWN_PEER, b""
+        return handler(self, peer, c)
+
+    def _op_add_peer(self, c: P.Cursor) -> tuple[int, bytes]:
+        keylen = c.u8()
+        if keylen == 0:
+            signer: ConsensusSignatureScheme = EthereumConsensusSigner.random()
+        elif keylen == 32:
+            signer = EthereumConsensusSigner(c.raw(32))
+        else:
+            return P.STATUS_BAD_REQUEST, P.string("key must be absent or 32 bytes")
+        if self._engine_factory is not None:
+            engine = self._engine_factory(signer)
+        else:
+            engine = TpuConsensusEngine(
+                signer,
+                event_bus=BroadcastEventBus(),
+                capacity=self._capacity,
+                voter_capacity=self._voter_capacity,
+            )
+        receiver = engine.event_bus().subscribe()
+        with self._lock:
+            peer_id = self._next_peer
+            self._next_peer += 1
+            self._peers[peer_id] = _Peer(peer_id, engine, receiver)
+        identity = signer.identity()
+        return P.STATUS_OK, P.u32(peer_id) + P.u8(len(identity)) + identity
+
+    def _op_create_proposal(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        scope = c.string()
+        now = c.u64()
+        name = c.string()
+        payload = c.blob()
+        expected_voters = c.u32()
+        rel_expiration = c.u64()
+        liveness = bool(c.u8())
+        request = CreateProposalRequest(
+            name=name,
+            payload=payload,
+            proposal_owner=peer.engine.signer().identity(),
+            expected_voters_count=expected_voters,
+            expiration_timestamp=rel_expiration,
+            liveness_criteria_yes=liveness,
+        )
+        proposal = peer.engine.create_proposal(scope, request, now)
+        return P.STATUS_OK, P.u32(proposal.proposal_id) + P.blob(proposal.encode())
+
+    def _op_cast_vote(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        scope = c.string()
+        pid = c.u32()
+        choice = bool(c.u8())
+        now = c.u64()
+        vote = peer.engine.cast_vote(scope, pid, choice, now)
+        return P.STATUS_OK, P.blob(vote.encode())
+
+    def _op_process_proposal(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        scope = c.string()
+        now = c.u64()
+        proposal = Proposal.decode(c.blob())
+        peer.engine.process_incoming_proposal(scope, proposal, now)
+        return P.STATUS_OK, b""
+
+    def _op_process_vote(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        scope = c.string()
+        now = c.u64()
+        vote = Vote.decode(c.blob())
+        peer.engine.process_incoming_vote(scope, vote, now)
+        return P.STATUS_OK, b""
+
+    def _op_handle_timeout(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        scope = c.string()
+        pid = c.u32()
+        now = c.u64()
+        result = peer.engine.handle_consensus_timeout(scope, pid, now)
+        return P.STATUS_OK, P.u8(1 if result else 0)
+
+    def _op_get_result(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        scope = c.string()
+        pid = c.u32()
+        try:
+            result = peer.engine.get_consensus_result(scope, pid)
+        except ConsensusError as exc:
+            from ..errors import StatusCode
+
+            if exc.code == StatusCode.CONSENSUS_FAILED:
+                return P.STATUS_OK, P.u8(P.RESULT_FAILED)
+            raise
+        if result is None:
+            return P.STATUS_OK, P.u8(P.RESULT_UNDECIDED)
+        return P.STATUS_OK, P.u8(P.RESULT_YES if result else P.RESULT_NO)
+
+    def _op_poll_events(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        events: list[tuple[str, ConsensusEvent]] = []
+        while True:
+            item = peer.receiver.try_recv()
+            if item is None:
+                break
+            # Filter to the encodable kinds BEFORE counting so the leading
+            # u32 always matches the records that follow.
+            if isinstance(item[1], (ConsensusReached, ConsensusFailedEvent)):
+                events.append(item)
+        out = [P.u32(len(events))]
+        for scope, event in events:
+            if isinstance(event, ConsensusReached):
+                out.append(
+                    P.string(str(scope))
+                    + P.u8(P.EVENT_REACHED)
+                    + P.u32(event.proposal_id)
+                    + P.u8(1 if event.result else 0)
+                    + P.u64(event.timestamp)
+                )
+            else:
+                out.append(
+                    P.string(str(scope))
+                    + P.u8(P.EVENT_FAILED)
+                    + P.u32(event.proposal_id)
+                    + P.u8(0)
+                    + P.u64(event.timestamp)
+                )
+        return P.STATUS_OK, b"".join(out)
+
+    def _op_get_proposal(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        scope = c.string()
+        pid = c.u32()
+        proposal = peer.engine.get_proposal(scope, pid)
+        return P.STATUS_OK, P.blob(proposal.encode())
+
+    def _op_get_stats(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        scope = c.string()
+        stats = peer.engine.get_scope_stats(scope)
+        return P.STATUS_OK, (
+            P.u32(stats.total_sessions)
+            + P.u32(stats.active_sessions)
+            + P.u32(stats.failed_sessions)
+            + P.u32(stats.consensus_reached)
+        )
+
+
+_HANDLERS = {
+    P.OP_CREATE_PROPOSAL: BridgeServer._op_create_proposal,
+    P.OP_CAST_VOTE: BridgeServer._op_cast_vote,
+    P.OP_PROCESS_PROPOSAL: BridgeServer._op_process_proposal,
+    P.OP_PROCESS_VOTE: BridgeServer._op_process_vote,
+    P.OP_HANDLE_TIMEOUT: BridgeServer._op_handle_timeout,
+    P.OP_GET_RESULT: BridgeServer._op_get_result,
+    P.OP_POLL_EVENTS: BridgeServer._op_poll_events,
+    P.OP_GET_PROPOSAL: BridgeServer._op_get_proposal,
+    P.OP_GET_STATS: BridgeServer._op_get_stats,
+}

@@ -1,0 +1,302 @@
+/* C reference embedder for the hashgraph_tpu bridge.
+ *
+ * Demonstrates that a non-Python process can drive the full consensus
+ * surface — create a proposal, cast votes, ferry Proposal/Vote protobuf
+ * bytes between peers, receive events — over the framed TCP protocol
+ * documented in hashgraph_tpu/bridge/protocol.py. The scenario is the
+ * reference library's 3-voter quick-start (reference: README.md:41-82):
+ * alice proposes, everyone votes YES, all three peers observe
+ * ConsensusReached(true).
+ *
+ * Build:  gcc -O2 -o bridge_demo native/bridge_client.c
+ * Run:    ./bridge_demo <host> <port>     (exit 0 = scenario passed)
+ *
+ * The first ~150 lines are a reusable mini client library (hgb_*); the
+ * quick-start itself is the few dozen lines of main().
+ */
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+/* ───────────────────────── wire primitives ───────────────────────── */
+
+enum {
+  OP_PING = 0,
+  OP_ADD_PEER = 1,
+  OP_CREATE_PROPOSAL = 2,
+  OP_CAST_VOTE = 3,
+  OP_PROCESS_PROPOSAL = 4,
+  OP_PROCESS_VOTE = 5,
+  OP_HANDLE_TIMEOUT = 6,
+  OP_GET_RESULT = 7,
+  OP_POLL_EVENTS = 8,
+  OP_GET_PROPOSAL = 9,
+  OP_GET_STATS = 10,
+};
+
+#define STATUS_OK 0
+#define RESULT_YES 1
+#define EVENT_REACHED 1
+#define HGB_MAX_FRAME (1 << 20)
+
+typedef struct {
+  uint8_t buf[HGB_MAX_FRAME];
+  uint32_t len;
+} hgb_buf;
+
+static void put_u8(hgb_buf* b, uint8_t v) { b->buf[b->len++] = v; }
+static void put_u16(hgb_buf* b, uint16_t v) {
+  b->buf[b->len++] = (uint8_t)v;
+  b->buf[b->len++] = (uint8_t)(v >> 8);
+}
+static void put_u32(hgb_buf* b, uint32_t v) {
+  for (int i = 0; i < 4; i++) b->buf[b->len++] = (uint8_t)(v >> (8 * i));
+}
+static void put_u64(hgb_buf* b, uint64_t v) {
+  for (int i = 0; i < 8; i++) b->buf[b->len++] = (uint8_t)(v >> (8 * i));
+}
+static void put_str(hgb_buf* b, const char* s) {
+  uint16_t n = (uint16_t)strlen(s);
+  put_u16(b, n);
+  memcpy(b->buf + b->len, s, n);
+  b->len += n;
+}
+static void put_blob(hgb_buf* b, const uint8_t* data, uint32_t n) {
+  put_u32(b, n);
+  memcpy(b->buf + b->len, data, n);
+  b->len += n;
+}
+
+typedef struct {
+  const uint8_t* p;
+  uint32_t len, pos;
+} hgb_cur;
+
+static uint8_t get_u8(hgb_cur* c) { return c->p[c->pos++]; }
+static uint16_t get_u16(hgb_cur* c) {
+  uint16_t v = (uint16_t)(c->p[c->pos] | (c->p[c->pos + 1] << 8));
+  c->pos += 2;
+  return v;
+}
+static uint32_t get_u32(hgb_cur* c) {
+  uint32_t v = 0;
+  for (int i = 0; i < 4; i++) v |= (uint32_t)c->p[c->pos + i] << (8 * i);
+  c->pos += 4;
+  return v;
+}
+static uint64_t get_u64(hgb_cur* c) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; i++) v |= (uint64_t)c->p[c->pos + i] << (8 * i);
+  c->pos += 8;
+  return v;
+}
+
+/* ───────────────────────── connection + call ─────────────────────── */
+
+static int hgb_connect(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      connect(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+static int io_all(int fd, uint8_t* buf, uint32_t n, int writing) {
+  while (n > 0) {
+    ssize_t k = writing ? write(fd, buf, n) : read(fd, buf, n);
+    if (k <= 0) return -1;
+    buf += k;
+    n -= (uint32_t)k;
+  }
+  return 0;
+}
+
+/* Sends opcode+payload, receives the response into resp (payload only).
+ * Returns the wire status byte, or -1 on transport failure. */
+static int hgb_call(int fd, uint8_t op, const hgb_buf* req, hgb_buf* resp) {
+  uint8_t head[5];
+  uint32_t len = 1 + (req ? req->len : 0);
+  for (int i = 0; i < 4; i++) head[i] = (uint8_t)(len >> (8 * i));
+  head[4] = op;
+  if (io_all(fd, head, 5, 1) != 0) return -1;
+  if (req && req->len && io_all(fd, (uint8_t*)req->buf, req->len, 1) != 0)
+    return -1;
+  uint8_t rhead[4];
+  if (io_all(fd, rhead, 4, 0) != 0) return -1;
+  uint32_t rlen = 0;
+  for (int i = 0; i < 4; i++) rlen |= (uint32_t)rhead[i] << (8 * i);
+  if (rlen < 1 || rlen > HGB_MAX_FRAME) return -1;
+  uint8_t status;
+  if (io_all(fd, &status, 1, 0) != 0) return -1;
+  resp->len = rlen - 1;
+  if (resp->len && io_all(fd, resp->buf, resp->len, 0) != 0) return -1;
+  return status;
+}
+
+/* ─────────────────────────── quick-start ─────────────────────────── */
+
+#define CHECK(cond, what)                                   \
+  do {                                                      \
+    if (!(cond)) {                                          \
+      fprintf(stderr, "FAIL: %s (line %d)\n", what, __LINE__); \
+      return 1;                                             \
+    }                                                       \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: %s <host> <port>\n", argv[0]);
+    return 2;
+  }
+  int fd = hgb_connect(argv[1], atoi(argv[2]));
+  CHECK(fd >= 0, "connect");
+
+  static hgb_buf req, resp;
+  hgb_cur cur;
+
+  /* handshake */
+  req.len = 0;
+  CHECK(hgb_call(fd, OP_PING, &req, &resp) == STATUS_OK, "ping");
+  cur = (hgb_cur){resp.buf, resp.len, 0};
+  printf("bridge protocol v%u\n", get_u32(&cur));
+
+  /* three peers: alice, bob, carol (each its own engine + signer) */
+  uint32_t peers[3];
+  const char* names[3] = {"alice", "bob", "carol"};
+  for (int i = 0; i < 3; i++) {
+    req.len = 0;
+    put_u8(&req, 0); /* server-generated key */
+    CHECK(hgb_call(fd, OP_ADD_PEER, &req, &resp) == STATUS_OK, "add_peer");
+    cur = (hgb_cur){resp.buf, resp.len, 0};
+    peers[i] = get_u32(&cur);
+    uint8_t idlen = get_u8(&cur);
+    printf("%s: peer %u, identity %u bytes\n", names[i], peers[i], idlen);
+  }
+  const char* scope = "quickstart";
+  uint64_t now = 1000000;
+
+  /* alice proposes to 3 voters, 600 s expiry, liveness YES */
+  req.len = 0;
+  put_u32(&req, peers[0]);
+  put_str(&req, scope);
+  put_u64(&req, now);
+  put_str(&req, "genesis-upgrade");
+  put_blob(&req, (const uint8_t*)"ship it", 7);
+  put_u32(&req, 3);
+  put_u64(&req, 600);
+  put_u8(&req, 1);
+  CHECK(hgb_call(fd, OP_CREATE_PROPOSAL, &req, &resp) == STATUS_OK,
+        "create_proposal");
+  cur = (hgb_cur){resp.buf, resp.len, 0};
+  uint32_t pid = get_u32(&cur);
+  printf("proposal %u created\n", pid);
+
+  /* alice votes YES, then gossips the proposal (with her vote embedded) */
+  req.len = 0;
+  put_u32(&req, peers[0]);
+  put_str(&req, scope);
+  put_u32(&req, pid);
+  put_u8(&req, 1);
+  put_u64(&req, now + 1);
+  CHECK(hgb_call(fd, OP_CAST_VOTE, &req, &resp) == STATUS_OK, "alice votes");
+
+  req.len = 0;
+  put_u32(&req, peers[0]);
+  put_str(&req, scope);
+  put_u32(&req, pid);
+  CHECK(hgb_call(fd, OP_GET_PROPOSAL, &req, &resp) == STATUS_OK,
+        "get_proposal");
+  cur = (hgb_cur){resp.buf, resp.len, 0};
+  uint32_t plen = get_u32(&cur);
+  static uint8_t proposal[HGB_MAX_FRAME];
+  CHECK(plen <= sizeof(proposal) && cur.pos + plen <= resp.len,
+        "proposal length sane");
+  memcpy(proposal, resp.buf + cur.pos, plen);
+
+  for (int i = 1; i < 3; i++) { /* bob + carol receive the proposal */
+    req.len = 0;
+    put_u32(&req, peers[i]);
+    put_str(&req, scope);
+    put_u64(&req, now + 2);
+    put_blob(&req, proposal, plen);
+    CHECK(hgb_call(fd, OP_PROCESS_PROPOSAL, &req, &resp) == STATUS_OK,
+          "process_proposal");
+  }
+
+  /* bob and carol vote YES; each vote is gossiped to the other two peers */
+  for (int voter = 1; voter < 3; voter++) {
+    req.len = 0;
+    put_u32(&req, peers[voter]);
+    put_str(&req, scope);
+    put_u32(&req, pid);
+    put_u8(&req, 1);
+    put_u64(&req, now + 3 + (uint64_t)voter);
+    CHECK(hgb_call(fd, OP_CAST_VOTE, &req, &resp) == STATUS_OK, "cast_vote");
+    cur = (hgb_cur){resp.buf, resp.len, 0};
+    uint32_t vlen = get_u32(&cur);
+    static uint8_t vote[4096];
+    CHECK(vlen <= sizeof(vote) && cur.pos + vlen <= resp.len,
+          "vote length sane");
+    memcpy(vote, resp.buf + cur.pos, vlen);
+    for (int other = 0; other < 3; other++) {
+      if (other == voter) continue;
+      req.len = 0;
+      put_u32(&req, peers[other]);
+      put_str(&req, scope);
+      put_u64(&req, now + 4 + (uint64_t)voter);
+      put_blob(&req, vote, vlen);
+      CHECK(hgb_call(fd, OP_PROCESS_VOTE, &req, &resp) == STATUS_OK,
+            "process_vote");
+    }
+  }
+
+  /* every peer must now report YES and have emitted ConsensusReached */
+  for (int i = 0; i < 3; i++) {
+    req.len = 0;
+    put_u32(&req, peers[i]);
+    put_str(&req, scope);
+    put_u32(&req, pid);
+    CHECK(hgb_call(fd, OP_GET_RESULT, &req, &resp) == STATUS_OK, "get_result");
+    cur = (hgb_cur){resp.buf, resp.len, 0};
+    CHECK(get_u8(&cur) == RESULT_YES, "consensus must be YES");
+
+    req.len = 0;
+    put_u32(&req, peers[i]);
+    CHECK(hgb_call(fd, OP_POLL_EVENTS, &req, &resp) == STATUS_OK,
+          "poll_events");
+    cur = (hgb_cur){resp.buf, resp.len, 0};
+    uint32_t count = get_u32(&cur);
+    int reached = 0;
+    for (uint32_t e = 0; e < count; e++) {
+      uint16_t slen = get_u16(&cur);
+      cur.pos += slen; /* scope */
+      uint8_t kind = get_u8(&cur);
+      uint32_t epid = get_u32(&cur);
+      uint8_t eresult = get_u8(&cur);
+      get_u64(&cur); /* timestamp */
+      if (kind == EVENT_REACHED && epid == pid && eresult) reached = 1;
+    }
+    CHECK(reached, "ConsensusReached(true) event");
+    printf("%s: consensus YES, %u event(s)\n", names[i], count);
+  }
+
+  close(fd);
+  printf("QUICKSTART PASS\n");
+  return 0;
+}

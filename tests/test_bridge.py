@@ -1,0 +1,169 @@
+"""Embedder bridge: the README 3-voter quick-start from outside Python.
+
+The reference is embedded in-process from Rust (reference: README.md:41-82,
+183-197); this framework's equivalent embedder boundary is the framed TCP
+protocol in hashgraph_tpu/bridge. Covered here:
+
+- the full quick-start through the Python reference client,
+- the same scenario through the compiled C client (native/bridge_client.c),
+  proving a non-Python process can create proposals, vote, ferry wire bytes
+  and receive events,
+- error-path parity: wire statuses mirror StatusCode, bridge-level statuses
+  cover unknown peers/opcodes, tampered votes are rejected with the same
+  error the in-process engine raises.
+"""
+
+import shutil
+import socket
+import struct
+import subprocess
+import sys
+
+import pytest
+
+from hashgraph_tpu.bridge import BridgeClient, BridgeError, BridgeServer
+from hashgraph_tpu.bridge import protocol as P
+from hashgraph_tpu.errors import ConsensusFailed, StatusCode
+from hashgraph_tpu.wire import Vote
+
+NOW = 1_700_000_000
+
+
+@pytest.fixture(scope="module")
+def server():
+    with BridgeServer(capacity=64, voter_capacity=8) as srv:
+        yield srv
+
+
+@pytest.fixture()
+def client(server):
+    with BridgeClient(*server.address) as cl:
+        yield cl
+
+
+def run_quickstart(cl: BridgeClient, scope: str):
+    """3 voters, gossipsub defaults, unanimous YES; returns (peers, pid)."""
+    peers = [cl.add_peer()[0] for _ in range(3)]
+    pid, _ = cl.create_proposal(peers[0], scope, NOW, "upgrade", b"ship", 3, 600)
+    cl.cast_vote(peers[0], scope, pid, True, NOW + 1)
+    proposal = cl.get_proposal(peers[0], scope, pid)
+    for peer in peers[1:]:
+        cl.process_proposal(peer, scope, proposal, NOW + 2)
+    for i, voter in enumerate(peers[1:], start=1):
+        vote = cl.cast_vote(voter, scope, pid, True, NOW + 2 + i)
+        for other in peers:
+            if other != voter:
+                cl.process_vote(other, scope, vote, NOW + 3 + i)
+    return peers, pid
+
+
+class TestPythonClient:
+    def test_quickstart_reaches_consensus_on_all_peers(self, client):
+        peers, pid = run_quickstart(client, "qs")
+        for peer in peers:
+            assert client.get_result(peer, "qs", pid) is True
+            events = client.poll_events(peer)
+            assert any(
+                e.kind == P.EVENT_REACHED and e.proposal_id == pid and e.result
+                for e in events
+            )
+
+    def test_stats_and_identities(self, client):
+        peer, identity = client.add_peer()
+        assert len(identity) == 20  # Ethereum address
+        pid, _ = client.create_proposal(peer, "st", NOW, "p", b"", 3, 600)
+        assert client.get_stats(peer, "st") == (1, 1, 0, 0)
+        assert client.get_result(peer, "st", pid) is None
+
+    def test_explicit_key_yields_deterministic_identity(self, client):
+        key = (7).to_bytes(32, "big")
+        _, identity = client.add_peer(key)
+        from hashgraph_tpu.signing.ethereum import EthereumConsensusSigner
+
+        assert identity == EthereumConsensusSigner(key).identity()
+
+    def test_duplicate_vote_maps_to_wire_status(self, client):
+        peer, _ = client.add_peer()
+        pid, _ = client.create_proposal(peer, "dup", NOW, "p", b"", 3, 600)
+        client.cast_vote(peer, "dup", pid, True, NOW + 1)
+        with pytest.raises(BridgeError) as exc:
+            client.cast_vote(peer, "dup", pid, True, NOW + 2)
+        assert exc.value.status == int(StatusCode.USER_ALREADY_VOTED)
+
+    def test_timeout_without_quorum_fails_session(self, client):
+        # n=2 runs the unanimity rule (reference: src/utils.rs:239-244):
+        # zero votes at timeout is undecidable regardless of liveness, so the
+        # session fails and the wire carries INSUFFICIENT_VOTES_AT_TIMEOUT.
+        peer, _ = client.add_peer()
+        pid, _ = client.create_proposal(peer, "to", NOW, "p", b"", 2, 600)
+        with pytest.raises(BridgeError) as exc:
+            client.handle_timeout(peer, "to", pid, NOW + 700)
+        assert exc.value.status == int(StatusCode.INSUFFICIENT_VOTES_AT_TIMEOUT)
+        with pytest.raises(ConsensusFailed):
+            client.get_result(peer, "to", pid)
+        events = client.poll_events(peer)
+        assert any(e.kind == P.EVENT_FAILED and e.proposal_id == pid for e in events)
+
+    def test_tampered_vote_rejected_like_in_process(self, client):
+        alice, _ = client.add_peer()
+        bob, _ = client.add_peer()
+        pid, _ = client.create_proposal(alice, "tam", NOW, "p", b"", 3, 600)
+        proposal = client.get_proposal(alice, "tam", pid)
+        client.process_proposal(bob, "tam", proposal, NOW + 1)
+        vote_bytes = client.cast_vote(bob, "tam", pid, False, NOW + 2)
+        vote = Vote.decode(vote_bytes)
+        vote.vote = True  # flip the choice without re-signing
+        with pytest.raises(BridgeError) as exc:
+            client.process_vote(alice, "tam", vote.encode(), NOW + 3)
+        assert exc.value.status == int(StatusCode.INVALID_VOTE_HASH)
+
+    def test_unknown_peer_and_session(self, client):
+        with pytest.raises(BridgeError) as exc:
+            client.get_result(999_999, "x", 1)
+        assert exc.value.status == P.STATUS_UNKNOWN_PEER
+        peer, _ = client.add_peer()
+        with pytest.raises(BridgeError) as exc:
+            client.get_result(peer, "x", 12345)
+        assert exc.value.status == int(StatusCode.SESSION_NOT_FOUND)
+
+    def test_unknown_opcode_and_truncated_frame(self, server):
+        host, port = server.address
+        with socket.create_connection((host, port), timeout=10) as sock:
+            sock.sendall(P.encode_frame(137, b""))
+            status, _ = P.read_frame(sock)
+            assert status == P.STATUS_UNKNOWN_OPCODE
+        with socket.create_connection((host, port), timeout=10) as sock:
+            # CREATE_PROPOSAL with a truncated payload: bad request, then the
+            # server keeps serving new connections.
+            sock.sendall(P.encode_frame(P.OP_CREATE_PROPOSAL, struct.pack("<I", 1)))
+            status, _ = P.read_frame(sock)
+            assert status == P.STATUS_BAD_REQUEST
+        with BridgeClient(host, port) as cl:
+            assert cl.ping() == P.PROTOCOL_VERSION
+
+
+class TestCClient:
+    def test_c_quickstart_end_to_end(self, server, tmp_path):
+        """Compile the C embedder and let it run the whole scenario."""
+        cc = shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
+        if cc is None:
+            pytest.skip("no C compiler available")
+        binary = tmp_path / "bridge_demo"
+        compile_proc = subprocess.run(
+            [cc, "-O2", "-o", str(binary), "native/bridge_client.c"],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert compile_proc.returncode == 0, compile_proc.stderr
+        host, port = server.address
+        proc = subprocess.run(
+            [str(binary), host, str(port)],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        assert "QUICKSTART PASS" in proc.stdout
+        for name in ("alice", "bob", "carol"):
+            assert f"{name}: consensus YES" in proc.stdout
