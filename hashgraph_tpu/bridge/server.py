@@ -71,6 +71,7 @@ class BridgeServer:
         self._listener: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         self._connections: set[socket.socket] = set()
+        self._handlers: set[threading.Thread] = set()
         self._running = False
 
     # ── lifecycle ──────────────────────────────────────────────────────
@@ -114,6 +115,13 @@ class BridgeServer:
                 pass
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
+        # Join in-flight handlers: a dispatch that was already running keeps
+        # the engine lock until it finishes; only after this loop is the
+        # "no further frames mutate the peer engines" guarantee true.
+        with self._lock:
+            handlers = list(self._handlers)
+        for thread in handlers:
+            thread.join(timeout=5)
 
     def __enter__(self) -> "BridgeServer":
         self.start()
@@ -137,11 +145,13 @@ class BridgeServer:
     def _serve_connection(self, conn: socket.socket) -> None:
         with self._lock:
             self._connections.add(conn)
+            self._handlers.add(threading.current_thread())
         try:
             self._serve_frames(conn)
         finally:
             with self._lock:
                 self._connections.discard(conn)
+                self._handlers.discard(threading.current_thread())
             try:
                 conn.close()
             except OSError:

@@ -819,11 +819,13 @@ class TpuConsensusEngine(Generic[Scope]):
             found = np.zeros(batch, bool)
             slots = np.zeros(batch, np.int64)
 
-        # Gids must be interned identities (voter_gid): an out-of-range gid
-        # gets a typed per-row status on BOTH substrates — previously the
-        # spill path raised IndexError mid-batch while the device path
-        # silently accepted any integer as a fresh voter.
-        bad_gid = (voter_gids < 0) | (voter_gids >= self._pool.voter_gid_count)
+        # Gids must be LIVE interned identities (voter_gid): out-of-range and
+        # freed/recycled ids get a typed per-row status on BOTH substrates —
+        # previously the spill path raised IndexError mid-batch while the
+        # device path silently accepted any integer as a fresh voter, and a
+        # stale gid held across an eviction could misattribute votes to
+        # whichever owner later claimed the recycled id.
+        bad_gid = ~self._pool.gids_live(voter_gids)
         if bad_gid.any():
             statuses[found & bad_gid] = int(StatusCode.EMPTY_VOTE_OWNER)
             found = found & ~bad_gid

@@ -221,6 +221,18 @@ class ProposalPool:
         # vectorized batch (lanes_for_batch, the columnar hot path).
         self._gid_of: dict[bytes, int] = {}
         self._owners: list[bytes] = []
+        # Registry bound: per-gid count of live slot-lane references. A gid
+        # whose last referencing slot is released drops its owner mapping and
+        # the id is recycled, so a long-lived pool churning through rotating
+        # voter populations holds only the currently-live identities (plus
+        # interned-but-never-voted ids, reclaimable via
+        # clear_voter_registry at a quiesce point). numpy arrays (geometric
+        # growth) keep the refcount bumps vectorized on the columnar path;
+        # _gid_live distinguishes mapped ids from freed ones so stale gids
+        # are rejected rather than misattributed.
+        self._gid_refs = np.zeros(0, np.int64)
+        self._gid_live = np.zeros(0, bool)
+        self._free_gids: list[int] = []
         self._lane_gids = np.full((capacity, voter_capacity), -1, np.int32)
         self._lane_count = np.zeros(capacity, np.int32)
         # Pipelining discipline: host mirror updates must apply in dispatch
@@ -258,12 +270,32 @@ class ProposalPool:
 
     def voter_gid(self, owner: bytes) -> int:
         """Intern owner bytes to a stable global voter id (first use
-        assigns). Columnar callers ship these ids instead of bytes."""
+        assigns; ids of fully-released voters are recycled). Columnar
+        callers ship these ids instead of bytes. A gid stays valid while
+        any live slot references it or until the next intern after its last
+        reference is released — callers must not hold gids across release
+        boundaries (engine calls are serialized under one lock, so a
+        batch's gids are stable for the duration of that batch)."""
         gid = self._gid_of.get(owner)
         if gid is None:
-            gid = len(self._owners)
+            if self._free_gids:
+                gid = self._free_gids.pop()
+                self._owners[gid] = owner
+                self._gid_refs[gid] = 0
+            else:
+                gid = len(self._owners)
+                self._owners.append(owner)
+                if gid >= len(self._gid_refs):
+                    grow = max(64, len(self._gid_refs))
+                    self._gid_refs = np.concatenate(
+                        [self._gid_refs, np.zeros(grow, np.int64)]
+                    )
+                    self._gid_live = np.concatenate(
+                        [self._gid_live, np.zeros(grow, bool)]
+                    )
+                self._gid_refs[gid] = 0
+            self._gid_live[gid] = True
             self._gid_of[owner] = gid
-            self._owners.append(owner)
         return gid
 
     def owner_of_gid(self, gid: int) -> bytes:
@@ -271,9 +303,26 @@ class ProposalPool:
 
     @property
     def voter_gid_count(self) -> int:
-        """Number of interned voter identities; valid gids are
-        [0, voter_gid_count)."""
+        """Size of the gid id-space; valid gids are [0, voter_gid_count).
+        Recycled ids keep this from growing with voter churn."""
         return len(self._owners)
+
+    @property
+    def live_voter_count(self) -> int:
+        """Number of owner identities currently mapped to a gid."""
+        return len(self._gid_of)
+
+    def gids_live(self, gids: np.ndarray) -> np.ndarray:
+        """Bool mask: True where the gid currently maps an interned owner.
+        Out-of-range ids and freed (recycled-but-unclaimed) ids are False —
+        columnar callers use this to reject stale gids instead of silently
+        attributing votes to whichever owner later claims the recycled id."""
+        gids = np.asarray(gids, np.int64)
+        out = np.zeros(len(gids), bool)
+        ok = (gids >= 0) & (gids < len(self._owners))
+        if ok.any():
+            out[ok] = self._gid_live[gids[ok]]
+        return out
 
     def clear_voter_registry(self) -> None:
         """Reset the owner↔gid interning tables.
@@ -292,6 +341,9 @@ class ProposalPool:
             )
         self._gid_of.clear()
         self._owners.clear()
+        self._gid_refs = np.zeros(0, np.int64)
+        self._gid_live = np.zeros(0, bool)
+        self._free_gids.clear()
 
     def lane_for(self, slot: int, owner: bytes) -> int | None:
         """Resolve (or first-come assign) one owner's voter lane on a slot.
@@ -309,6 +361,7 @@ class ProposalPool:
             return None
         row[count] = gid
         self._lane_count[slot] = count + 1
+        self._gid_refs[gid] += 1
         return count
 
     def lanes_for_batch(self, slots: np.ndarray, gids: np.ndarray) -> np.ndarray:
@@ -363,6 +416,13 @@ class ProposalPool:
         self._lane_count += np.bincount(
             uslot[valid], minlength=self.capacity
         ).astype(np.int32)
+        assigned = ugid[valid].astype(np.int64)
+        if assigned.size:
+            # Only interned gids participate in refcounted eviction;
+            # synthetic ids from direct pool callers pass through
+            # unrefcounted (and are never evicted).
+            in_range = (assigned >= 0) & (assigned < len(self._owners))
+            np.add.at(self._gid_refs, assigned[in_range], 1)
         lanes[rem] = np.where(valid, lane_uniq, -1)[inverse].astype(np.int32)
         return lanes
 
@@ -422,9 +482,8 @@ class ProposalPool:
 
         expiry = np.asarray(expiry, np.int64)
         created_at = np.asarray(created_at, np.int64)
-        slot_arr = np.asarray(slots)
-        self._lane_gids[slot_arr] = -1
-        self._lane_count[slot_arr] = 0
+        # Lane rows need no clearing here: free slots always have cleared
+        # rows (initialised at construction, retired on release).
         for i, slot in enumerate(slots):
             self._state_host[slot] = STATE_ACTIVE
             self._expiry_host[slot] = expiry[i]
@@ -458,16 +517,42 @@ class ProposalPool:
 
     def release(self, slots: list[int]) -> None:
         """Return slots to the free list (eviction / delete_scope). Tallies
-        are lazily cleared on the next allocation of the slot."""
+        are lazily cleared on the next allocation of the slot; lane tables
+        are retired now so fully-released voter identities leave the
+        registry (the id is recycled by a later intern)."""
         if not slots:
             return
         self._check_no_inflight("release")
         self._dispatch_release(np.asarray(slots, np.int32))
+        self._retire_lanes(np.asarray(slots, np.int64))
         for slot in slots:
             self._state_host[slot] = STATE_FREE
             self._expiry_host[slot] = 0
             del self._meta[slot]
             self._free.append(slot)
+
+    def _retire_lanes(self, slot_arr: np.ndarray) -> None:
+        """Drop the released slots' lane references; evict gids that no live
+        slot references anymore."""
+        slot_arr = np.unique(slot_arr)  # a duplicated slot must not double-deref
+        rows = self._lane_gids[slot_arr]
+        referenced = rows[rows >= 0].astype(np.int64)
+        self._lane_gids[slot_arr] = -1
+        self._lane_count[slot_arr] = 0
+        if referenced.size == 0:
+            return
+        referenced = referenced[referenced < len(self._owners)]
+        if referenced.size == 0:
+            return
+        gids, counts = np.unique(referenced, return_counts=True)
+        self._gid_refs[gids] -= counts
+        # _gid_live gates eviction so synthetic (never-interned) ids and
+        # already-freed ids are skipped.
+        for gid in gids[(self._gid_refs[gids] <= 0) & self._gid_live[gids]].tolist():
+            del self._gid_of[self._owners[gid]]
+            self._owners[gid] = b""
+            self._gid_live[gid] = False
+            self._free_gids.append(gid)
 
     # ── Hot paths ──────────────────────────────────────────────────────
 

@@ -1,0 +1,147 @@
+"""Voter-identity registry bounds under churn.
+
+The pool interns owner bytes to dense gids for the columnar/lane machinery.
+Without eviction a long-lived deployment with rotating voter populations
+leaks host memory (one entry per identity ever seen). The registry is
+refcounted by live slot-lane references: releasing a voter's last slot drops
+the mapping and recycles the id, so steady-state size tracks the *live*
+population, not the historical one.
+"""
+
+import numpy as np
+
+from hashgraph_tpu import CreateProposalRequest, StubConsensusSigner, build_vote
+from hashgraph_tpu.engine import ProposalPool, TpuConsensusEngine
+
+from common import NOW, random_stub_signer
+
+
+class TestPoolRegistryRefcounts:
+    def test_release_evicts_unreferenced_gids_and_recycles_ids(self):
+        pool = ProposalPool(8, 4)
+        (slot_a,) = pool.allocate_batch(
+            [b"a"], n=[3], req=[2], cap=[0], gossip=[True], liveness=[True],
+            expiry=[NOW + 100], created_at=[NOW],
+        )
+        (slot_b,) = pool.allocate_batch(
+            [b"b"], n=[3], req=[2], cap=[0], gossip=[True], liveness=[True],
+            expiry=[NOW + 100], created_at=[NOW],
+        )
+        shared, only_a = b"voter-shared", b"voter-a"
+        assert pool.lane_for(slot_a, shared) == 0
+        assert pool.lane_for(slot_a, only_a) == 1
+        assert pool.lane_for(slot_b, shared) == 0
+        assert pool.live_voter_count == 2
+        pool.release([slot_a])
+        # only_a lost its last reference; shared is still held by slot_b.
+        assert pool.live_voter_count == 1
+        assert pool.voter_gid(shared) == pool.voter_gid(shared)
+        # The freed id is recycled by the next fresh intern.
+        before = pool.voter_gid_count
+        pool.voter_gid(b"voter-new")
+        assert pool.voter_gid_count == before
+        pool.release([slot_b])
+        assert pool.live_voter_count == 1  # voter-new (interned, never voted)
+
+    def test_batch_lane_assignment_is_refcounted(self):
+        pool = ProposalPool(8, 4)
+        slots = pool.allocate_batch(
+            [b"a", b"b"], n=[3, 3], req=[2, 2], cap=[0, 0],
+            gossip=[True, True], liveness=[True, True],
+            expiry=[NOW + 100] * 2, created_at=[NOW] * 2,
+        )
+        gids = [pool.voter_gid(b"v%d" % i) for i in range(3)]
+        # v0 votes on both slots, v1/v2 on one each.
+        batch_slots = np.array([slots[0], slots[1], slots[0], slots[1]])
+        batch_gids = np.array([gids[0], gids[0], gids[1], gids[2]])
+        lanes = pool.lanes_for_batch(batch_slots, batch_gids)
+        assert (lanes >= 0).all()
+        pool.release([slots[0]])
+        # v0 still referenced by slots[1]; v1 fully released.
+        assert pool.live_voter_count == 2  # v0 + v2
+        pool.release([slots[1]])
+        assert pool.live_voter_count == 0
+        assert len(pool._free_gids) == pool.voter_gid_count
+
+
+class TestStaleGids:
+    def test_gids_live_mask(self):
+        pool = ProposalPool(4, 4)
+        (slot,) = pool.allocate_batch(
+            [b"k"], n=[2], req=[2], cap=[0], gossip=[True], liveness=[True],
+            expiry=[NOW + 100], created_at=[NOW],
+        )
+        gid = pool.voter_gid(b"transient")
+        assert pool.lane_for(slot, b"transient") is not None
+        assert pool.gids_live(np.array([gid, -1, 10_000])).tolist() == [
+            True, False, False,
+        ]
+        pool.release([slot])
+        # Freed id: live mask flips off even though the id is range-valid.
+        assert pool.gids_live(np.array([gid])).tolist() == [False]
+
+    def test_columnar_rejects_stale_gid_after_eviction(self):
+        """A gid held across a release boundary must get a typed rejection,
+        not silently attribute the vote to the id's next claimant."""
+        from hashgraph_tpu import StatusCode
+
+        engine = TpuConsensusEngine(random_stub_signer(), capacity=8, voter_capacity=4)
+        request = CreateProposalRequest(
+            name="p", payload=b"", proposal_owner=b"o",
+            expected_voters_count=3, expiration_timestamp=1000,
+            liveness_criteria_yes=True,
+        )
+        first = engine.create_proposal("s", request, NOW)
+        stale = engine.voter_gid(b"old-voter")
+        statuses = engine.ingest_columnar(
+            "s",
+            np.array([first.proposal_id]),
+            np.array([stale]),
+            np.array([True]),
+            NOW + 1,
+        )
+        assert statuses[0] in (int(StatusCode.OK), int(StatusCode.ALREADY_REACHED))
+        engine.delete_scope("s")  # releases the slot; old-voter fully freed
+        second = engine.create_proposal("s2", request, NOW)
+        statuses = engine.ingest_columnar(
+            "s2",
+            np.array([second.proposal_id]),
+            np.array([stale]),
+            np.array([True]),
+            NOW + 1,
+        )
+        assert statuses[0] == int(StatusCode.EMPTY_VOTE_OWNER)
+
+
+class TestEngineChurn:
+    def test_rotating_voter_population_holds_registry_steady(self):
+        """100 generations of 8 fresh voters each; scope deletion after each
+        generation must keep the registry at one live generation, not 800
+        identities."""
+        engine = TpuConsensusEngine(
+            random_stub_signer(), capacity=32, voter_capacity=16
+        )
+        sizes = []
+        for gen in range(100):
+            scope = f"gen-{gen}"
+            request = CreateProposalRequest(
+                name="p",
+                payload=b"",
+                proposal_owner=b"owner",
+                expected_voters_count=8,
+                expiration_timestamp=1000,
+                liveness_criteria_yes=True,
+            )
+            proposal = engine.create_proposal(scope, request, NOW)
+            voters = [StubConsensusSigner(b"g%03d-v%d" % (gen, i)) for i in range(8)]
+            for voter in voters:
+                current = engine.get_proposal(scope, proposal.proposal_id)
+                vote = build_vote(current, True, voter, NOW + 1)
+                engine.process_incoming_vote(scope, vote, NOW + 2)
+            assert engine.get_consensus_result(scope, proposal.proposal_id) is True
+            engine.delete_scope(scope)
+            sizes.append(engine.pool().live_voter_count)
+        # Live mappings never accumulate across generations...
+        assert max(sizes) <= 16, sizes
+        # ...and the id space stops growing once recycling kicks in.
+        assert engine.pool().voter_gid_count <= 32
