@@ -97,6 +97,14 @@ class SessionRecord(Generic[Scope]):
     created_at: int
     votes: dict[bytes, Vote] = field(default_factory=dict)  # accepted only
     session: ConsensusSession | None = None  # host fallback substrate
+    # Opt-in columnar retention: verbatim wire bytes of accepted votes as
+    # (packed blob, local offsets) chunks in arrival order. Decoded lazily
+    # on proposal export so a columnar-ingested session can be re-gossiped
+    # with a chain-valid vote list; empty unless the caller passed
+    # wire_votes to ingest_columnar. ``retained_cache`` memoizes the decode
+    # (chunk-count keyed: retained_wire only grows by append).
+    retained_wire: list[tuple[bytes, np.ndarray]] = field(default_factory=list)
+    retained_cache: tuple[int, list[Vote]] | None = None
 
     def bump_round(self, accepted: int) -> None:
         """Host mirror of the device round update
@@ -594,7 +602,7 @@ class TpuConsensusEngine(Generic[Scope]):
     ) -> Proposal:
         """reference: src/service.rs:243-253"""
         self.cast_vote(scope, proposal_id, choice, now)
-        return self._get_record(scope, proposal_id).proposal.clone()
+        return self._materialized_proposal(self._get_record(scope, proposal_id))
 
     def process_incoming_vote(self, scope: Scope, vote: Vote, now: int) -> None:
         """Scalar network-vote entry point (reference: src/service.rs:286-305):
@@ -767,7 +775,15 @@ class TpuConsensusEngine(Generic[Scope]):
         return statuses
 
     def voter_gid(self, owner: bytes) -> int:
-        """Intern an owner identity for the columnar ingest path."""
+        """Intern an owner identity for the columnar ingest path.
+
+        LIFETIME CONTRACT: a gid is stable only while its owner has live
+        sessions referencing it. Any call that can release sessions
+        (delete_scope, per-scope-cap eviction inside create_proposal, spill)
+        may free the id, after which it is rejected (typed status) until the
+        id is recycled by a later intern — a stale gid used after recycling
+        is attributed to the new claimant. Re-intern per batch (a dict hit)
+        rather than holding gids across calls that mutate membership."""
         return self._pool.voter_gid(owner)
 
     def ingest_columnar(
@@ -778,6 +794,7 @@ class TpuConsensusEngine(Generic[Scope]):
         values: np.ndarray,
         now: int,
         max_depth: int = 8,
+        wire_votes: "list[bytes] | tuple[bytes, np.ndarray] | None" = None,
     ) -> np.ndarray:
         """THE throughput path: apply an arrival-ordered vote batch given as
         dense columns (structure-of-arrays) — proposal ids, interned voter
@@ -787,8 +804,15 @@ class TpuConsensusEngine(Generic[Scope]):
         ``pre_validated=True`` (validation, when needed, happens upstream:
         wire decode + signature verification are batch host stages), with
         two deliberate trade-offs, both documented in PARITY.md:
-        - no per-vote ``Vote`` objects are accumulated host-side, so gossip
-          reconstruction/export sees tallies but not vote chains;
+        - by default no per-vote ``Vote`` objects are accumulated host-side,
+          so gossip reconstruction/export sees tallies but not vote chains;
+          pass ``wire_votes`` (the encoded Vote bytes per row, either a list
+          or a ``(packed, offsets)`` pair) to retain accepted rows' verbatim
+          bytes off the timing path — proposal exports then re-embed them in
+          arrival order, so the proposal re-gossips with a chain-valid vote
+          list (reference: src/utils.rs:175-215). Retention assumes the
+          session is fed columnar-only (mixing scalar and columnar ingest on
+          one session interleaves the two vote lists by path, not arrival);
         - event ordering is guaranteed per-session, not across sessions.
 
         Resolution is fully vectorized (sorted-array searchsorted for
@@ -798,6 +822,90 @@ class TpuConsensusEngine(Generic[Scope]):
         transfers overlap device compute. Returns int32 statuses in batch
         order (reference semantics per code, as ingest_votes).
         """
+        proposal_ids = np.asarray(proposal_ids, np.int64)
+        wire_norm = (
+            self._normalize_wire(wire_votes, len(proposal_ids))
+            if wire_votes is not None
+            else None
+        )
+        statuses = self._ingest_columnar_apply(
+            scope, proposal_ids, voter_gids, values, now, max_depth
+        )
+        if wire_norm is not None:
+            self._retain_wire(scope, statuses, proposal_ids, wire_norm)
+        return statuses
+
+    @staticmethod
+    def _normalize_wire(wire_votes, batch: int) -> tuple[np.ndarray, np.ndarray]:
+        """Validate and normalize wire_votes to (u8 data, i64 offsets)
+        BEFORE any state mutates — a malformed argument must fail the call,
+        not strand already-applied votes without their retained bytes."""
+        if isinstance(wire_votes, tuple):
+            data, offsets = wire_votes
+            data_arr = (
+                np.frombuffer(data, np.uint8)
+                if isinstance(data, (bytes, bytearray, memoryview))
+                else np.asarray(data, np.uint8)
+            )
+            offsets = np.asarray(offsets, np.int64)
+        else:
+            data_arr = np.frombuffer(b"".join(wire_votes), np.uint8)
+            offsets = np.zeros(len(wire_votes) + 1, np.int64)
+            np.cumsum([len(b) for b in wire_votes], out=offsets[1:])
+        if len(offsets) != batch + 1:
+            raise ValueError("wire_votes must supply one entry per batch row")
+        if len(offsets) and int(offsets[-1]) > len(data_arr):
+            raise ValueError("wire_votes offsets exceed the packed data")
+        return data_arr, offsets
+
+    def _retain_wire(
+        self,
+        scope: Scope,
+        statuses: np.ndarray,
+        proposal_ids: np.ndarray,
+        wire_norm: tuple[np.ndarray, np.ndarray],
+    ) -> None:
+        """Attach accepted rows' verbatim vote bytes to their session
+        records (vectorized gather; one Python iteration per touched
+        session, not per vote)."""
+        ok_rows = np.nonzero(statuses == int(StatusCode.OK))[0]
+        if ok_rows.size == 0:
+            return
+        data_arr, offsets = wire_norm
+        # An OK status implies the pid resolved, so the table hit is exact.
+        pids_sorted, slots_sorted = self._pid_table(scope)
+        pos = np.searchsorted(pids_sorted, proposal_ids[ok_rows])
+        slots = slots_sorted[pos]
+        order = np.argsort(slots, kind="stable")  # keeps arrival order per slot
+        rows = ok_rows[order]
+        s_sorted = slots[order]
+        starts = offsets[rows]
+        lens = offsets[rows + 1] - starts
+        out_off = np.zeros(len(rows) + 1, np.int64)
+        np.cumsum(lens, out=out_off[1:])
+        gather = (
+            np.arange(int(out_off[-1]), dtype=np.int64)
+            - np.repeat(out_off[:-1], lens)
+            + np.repeat(starts, lens)
+        )
+        blob = data_arr[gather]
+        uniq, seg_start = np.unique(s_sorted, return_index=True)
+        seg_bounds = np.append(seg_start, len(rows))
+        for k, slot in enumerate(uniq.tolist()):
+            lo, hi = int(seg_bounds[k]), int(seg_bounds[k + 1])
+            seg_off = (out_off[lo : hi + 1] - out_off[lo]).copy()
+            seg_blob = blob[int(out_off[lo]) : int(out_off[hi])].tobytes()
+            self._records[int(slot)].retained_wire.append((seg_blob, seg_off))
+
+    def _ingest_columnar_apply(
+        self,
+        scope: Scope,
+        proposal_ids: np.ndarray,
+        voter_gids: np.ndarray,
+        values: np.ndarray,
+        now: int,
+        max_depth: int = 8,
+    ) -> np.ndarray:
         from .pool import group_batch
 
         proposal_ids = np.asarray(proposal_ids, np.int64)
@@ -820,11 +928,12 @@ class TpuConsensusEngine(Generic[Scope]):
             slots = np.zeros(batch, np.int64)
 
         # Gids must be LIVE interned identities (voter_gid): out-of-range and
-        # freed/recycled ids get a typed per-row status on BOTH substrates —
-        # previously the spill path raised IndexError mid-batch while the
-        # device path silently accepted any integer as a fresh voter, and a
-        # stale gid held across an eviction could misattribute votes to
-        # whichever owner later claimed the recycled id.
+        # freed-but-unclaimed ids get a typed per-row status on BOTH
+        # substrates — previously the spill path raised IndexError mid-batch
+        # while the device path silently accepted any integer as a fresh
+        # voter. NOTE: a stale gid used after its id has been recycled by a
+        # NEW intern is indistinguishable from the new owner — that misuse
+        # is excluded by voter_gid's lifetime contract (re-intern per batch).
         bad_gid = ~self._pool.gids_live(voter_gids)
         if bad_gid.any():
             statuses[found & bad_gid] = int(StatusCode.EMPTY_VOTE_OWNER)
@@ -1105,8 +1214,34 @@ class TpuConsensusEngine(Generic[Scope]):
 
     # ── Queries (reference: src/storage.rs:112-180 derived helpers) ────
 
+    def _decoded_retained(self, record: SessionRecord[Scope]) -> list[Vote]:
+        """Decode a record's retained wire bytes once per growth; exports
+        clone the cached Vote objects so callers can't mutate the cache."""
+        n = len(record.retained_wire)
+        if n == 0:
+            return []
+        if record.retained_cache is None or record.retained_cache[0] != n:
+            votes: list[Vote] = []
+            for data, offs in record.retained_wire:
+                votes.extend(
+                    Vote.decode(data[offs[k] : offs[k + 1]])
+                    for k in range(len(offs) - 1)
+                )
+            record.retained_cache = (n, votes)
+        return record.retained_cache[1]
+
+    def _materialized_proposal(self, record: SessionRecord[Scope]) -> Proposal:
+        """Export view of a record's proposal: retained columnar wire bytes
+        (if any) are decoded and re-embedded after the scalar-ingested votes,
+        in arrival order, so the result chain-validates at a receiving peer."""
+        proposal = record.proposal.clone()
+        retained = self._decoded_retained(record)
+        if retained:
+            proposal.votes = list(proposal.votes) + [v.clone() for v in retained]
+        return proposal
+
     def get_proposal(self, scope: Scope, proposal_id: int) -> Proposal:
-        return self._get_record(scope, proposal_id).proposal.clone()
+        return self._materialized_proposal(self._get_record(scope, proposal_id))
 
     def get_consensus_result(self, scope: Scope, proposal_id: int) -> bool | None:
         """None while active; raises ConsensusFailed for a failed session —
@@ -1125,7 +1260,7 @@ class TpuConsensusEngine(Generic[Scope]):
 
     def get_active_proposals(self, scope: Scope) -> list[Proposal]:
         return [
-            r.proposal.clone()
+            self._materialized_proposal(r)
             for r in self._scope_records(scope)
             if self._state_code(r) == STATE_ACTIVE
         ]
@@ -1135,7 +1270,7 @@ class TpuConsensusEngine(Generic[Scope]):
         for r in self._scope_records(scope):
             state = self._state_code(r)
             if state in (STATE_REACHED_YES, STATE_REACHED_NO):
-                out.append((r.proposal.clone(), state == STATE_REACHED_YES))
+                out.append((self._materialized_proposal(r), state == STATE_REACHED_YES))
         return out
 
     def get_scope_stats(self, scope: Scope) -> ConsensusStats:
@@ -1154,16 +1289,44 @@ class TpuConsensusEngine(Generic[Scope]):
 
     def export_session(self, scope: Scope, proposal_id: int) -> ConsensusSession:
         """Materialise a scalar ConsensusSession from the pooled state —
-        the bridge back to ConsensusStorage backends (checkpoint/interop)."""
+        the bridge back to ConsensusStorage backends (checkpoint/interop).
+
+        Pooled sessions read their columnar tallies back from the device
+        (lane -> owner via the gid registry); rows whose verbatim wire bytes
+        were retained export as real signed votes instead of tallies, so the
+        re-gossip capability survives a save/load round-trip."""
         record = self._get_record(scope, proposal_id)
+        retained = self._decoded_retained(record)
         if record.session is not None:
-            return record.session.clone()
+            session = record.session.clone()
+            for vote in retained:
+                # A retained signed vote supersedes its tally entry.
+                session.tallies.pop(vote.vote_owner, None)
+                if vote.vote_owner not in session.votes:
+                    session.votes[vote.vote_owner] = vote.clone()
+                    session.proposal.votes.append(vote.clone())
+            return session
+        votes = {k: v.clone() for k, v in record.votes.items()}
+        tallies: dict[bytes, bool] = {}
+        row = self._pool.read_slot(record.slot)
+        lane_owners = self._pool.lane_owners(record.slot)
+        for lane in np.nonzero(row["vote_mask"])[0]:
+            owner = lane_owners.get(int(lane))
+            if owner is None or owner in votes:
+                continue  # scalar votes already carry this participant
+            tallies[owner] = bool(row["vote_val"][lane])
+        for vote in retained:
+            tallies.pop(vote.vote_owner, None)
+            votes.setdefault(vote.vote_owner, vote.clone())
         return ConsensusSession(
-            proposal=record.proposal.clone(),
+            # The materialized proposal embeds retained votes in chain
+            # order, so re-gossip capability survives save -> load.
+            proposal=self._materialized_proposal(record),
             state=_STATE_TO_SCALAR[self._pool.state_of(record.slot)],
-            votes={k: v.clone() for k, v in record.votes.items()},
+            votes=votes,
             created_at=record.created_at,
             config=record.config,
+            tallies=tallies,
         )
 
     # ── Checkpoint / resume (SURVEY §5: host storage is the source of

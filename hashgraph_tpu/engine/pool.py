@@ -312,6 +312,16 @@ class ProposalPool:
         """Number of owner identities currently mapped to a gid."""
         return len(self._gid_of)
 
+    def lane_owners(self, slot: int) -> dict[int, bytes]:
+        """lane -> owner bytes for one slot's assigned lanes (export path)."""
+        row = self._lane_gids[slot]
+        out: dict[int, bytes] = {}
+        for lane in range(int(self._lane_count[slot])):
+            gid = int(row[lane])
+            if 0 <= gid < len(self._owners) and self._gid_live[gid]:
+                out[lane] = self._owners[gid]
+        return out
+
     def gids_live(self, gids: np.ndarray) -> np.ndarray:
         """Bool mask: True where the gid currently maps an interned owner.
         Out-of-range ids and freed (recycled-but-unclaimed) ids are False —
@@ -418,11 +428,14 @@ class ProposalPool:
         ).astype(np.int32)
         assigned = ugid[valid].astype(np.int64)
         if assigned.size:
-            # Only interned gids participate in refcounted eviction;
-            # synthetic ids from direct pool callers pass through
-            # unrefcounted (and are never evicted).
+            # Only LIVE interned gids participate in refcounted eviction;
+            # synthetic ids from direct pool callers — including in-range
+            # freed ids — pass through unrefcounted (and are never evicted),
+            # so they cannot desync a recycled id's count.
             in_range = (assigned >= 0) & (assigned < len(self._owners))
-            np.add.at(self._gid_refs, assigned[in_range], 1)
+            sel = assigned[in_range]
+            sel = sel[self._gid_live[sel]]
+            np.add.at(self._gid_refs, sel, 1)
         lanes[rem] = np.where(valid, lane_uniq, -1)[inverse].astype(np.int32)
         return lanes
 

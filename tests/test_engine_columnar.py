@@ -388,6 +388,195 @@ class TestColumnarSpillIntegrity:
         }
 
 
+class TestWireRetention:
+    """Opt-in wire_votes retention closes the columnar chain gap: a proposal
+    ingested columnar can be re-gossiped and chain-validates at a peer
+    (reference: src/utils.rs:175-215, src/service.rs:216-237)."""
+
+    def _chained_votes(self, proposal, signers, now):
+        """Build a chain-linked vote list the way real peers would: each
+        vote links to the proposal's current tail."""
+        votes = []
+        ferry = proposal.clone()
+        for i, signer in enumerate(signers):
+            vote = build_vote(ferry, True, signer, now + i)
+            ferry.votes.append(vote)
+            votes.append(vote)
+        return votes
+
+    def test_regossip_after_columnar_ingest_chain_validates_at_peer(self):
+        engine_a = make_engine()
+        engine_b = make_engine()
+        # n=4 with liveness: the 3rd YES is the deciding vote, so all three
+        # rows are accepted (OK) and retained.
+        proposal = engine_a.create_proposal("s", request(n=4), NOW)
+        signers = [random_stub_signer() for _ in range(3)]
+        votes = self._chained_votes(proposal, signers, NOW + 1)
+
+        gids = np.array([engine_a.voter_gid(v.vote_owner) for v in votes])
+        statuses = engine_a.ingest_columnar(
+            "s",
+            np.full(len(votes), proposal.proposal_id, np.int64),
+            gids,
+            np.array([v.vote for v in votes]),
+            NOW + 10,
+            wire_votes=[v.encode() for v in votes],
+        )
+        assert (statuses == int(StatusCode.OK)).all(), statuses
+        assert engine_a.get_consensus_result("s", proposal.proposal_id) is True
+
+        # Re-gossip: the exported proposal embeds the verbatim signed votes
+        # in arrival order; a second engine runs the FULL validation gauntlet
+        # (signatures + hash chain) on it.
+        exported = engine_a.get_proposal("s", proposal.proposal_id)
+        assert len(exported.votes) == 3
+        assert [v.vote_owner for v in exported.votes] == [
+            v.vote_owner for v in votes
+        ]
+        wire = exported.encode()
+        from hashgraph_tpu import Proposal
+
+        engine_b.process_incoming_proposal("s", Proposal.decode(wire), NOW + 11)
+        assert engine_b.get_consensus_result("s", proposal.proposal_id) is True
+
+    def test_retention_skips_rejected_rows(self):
+        engine = make_engine()
+        proposal = engine.create_proposal("s", request(n=4), NOW)
+        signers = [random_stub_signer() for _ in range(2)]
+        votes = self._chained_votes(proposal, signers, NOW + 1)
+        # Duplicate the second vote: the replay must be rejected AND not
+        # retained (a retained duplicate would poison the exported chain).
+        batch = votes + [votes[1]]
+        gids = np.array([engine.voter_gid(v.vote_owner) for v in batch])
+        statuses = engine.ingest_columnar(
+            "s",
+            np.full(len(batch), proposal.proposal_id, np.int64),
+            gids,
+            np.array([v.vote for v in batch]),
+            NOW + 10,
+            wire_votes=[v.encode() for v in batch],
+        )
+        assert statuses.tolist()[:2] == [int(StatusCode.OK)] * 2
+        assert statuses[2] == int(StatusCode.DUPLICATE_VOTE)
+        exported = engine.get_proposal("s", proposal.proposal_id)
+        assert len(exported.votes) == 2
+
+    def test_multi_batch_retention_preserves_arrival_order(self):
+        engine = make_engine()
+        # n=5, liveness NO: the 4th YES decides (required=4), so all four
+        # rows across the two batches are accepted and retained.
+        proposal = engine.create_proposal("s", request(n=5, liveness=False), NOW)
+        signers = [random_stub_signer() for _ in range(4)]
+        votes = self._chained_votes(proposal, signers, NOW + 1)
+        for half in (votes[:2], votes[2:]):
+            gids = np.array([engine.voter_gid(v.vote_owner) for v in half])
+            statuses = engine.ingest_columnar(
+                "s",
+                np.full(len(half), proposal.proposal_id, np.int64),
+                gids,
+                np.array([v.vote for v in half]),
+                NOW + 10,
+                wire_votes=[v.encode() for v in half],
+            )
+            assert (statuses == int(StatusCode.OK)).all()
+        exported = engine.get_proposal("s", proposal.proposal_id)
+        assert [v.vote_owner for v in exported.votes] == [
+            v.vote_owner for v in votes
+        ]
+        # Chain-validate locally as a peer would.
+        from hashgraph_tpu.protocol import validate_vote_chain
+
+        validate_vote_chain(exported.votes)
+
+    def test_no_retention_without_opt_in(self):
+        engine = make_engine()
+        proposal = engine.create_proposal("s", request(n=3), NOW)
+        signers = [random_stub_signer() for _ in range(2)]
+        votes = self._chained_votes(proposal, signers, NOW + 1)
+        gids = np.array([engine.voter_gid(v.vote_owner) for v in votes])
+        engine.ingest_columnar(
+            "s",
+            np.full(len(votes), proposal.proposal_id, np.int64),
+            gids,
+            np.array([v.vote for v in votes]),
+            NOW + 10,
+        )
+        assert engine.get_proposal("s", proposal.proposal_id).votes == []
+
+    def test_checkpoint_roundtrip_preserves_retained_chain_and_pooled_tallies(self):
+        """save/load must not drop the re-gossip capability: retained votes
+        export as real signed votes, unretained pooled rows as tallies."""
+        from hashgraph_tpu import InMemoryConsensusStorage, Proposal
+
+        engine = make_engine()
+        proposal = engine.create_proposal("s", request(n=4), NOW)
+        signers = [random_stub_signer() for _ in range(3)]
+        votes = self._chained_votes(proposal, signers, NOW + 1)
+        gids = np.array([engine.voter_gid(v.vote_owner) for v in votes])
+        statuses = engine.ingest_columnar(
+            "s",
+            np.full(len(votes), proposal.proposal_id, np.int64),
+            gids,
+            np.array([v.vote for v in votes]),
+            NOW + 10,
+            wire_votes=[v.encode() for v in votes],
+        )
+        assert (statuses == int(StatusCode.OK)).all()
+
+        # Also a tally-only (unretained) session in the same scope.
+        plain = engine.create_proposal("s", request(n=4, name="plain"), NOW)
+        owner = b"\x55" * 20
+        engine.ingest_columnar(
+            "s",
+            np.array([plain.proposal_id], np.int64),
+            np.array([engine.voter_gid(owner)]),
+            np.array([True]),
+            NOW + 10,
+        )
+
+        storage = InMemoryConsensusStorage()
+        engine.save_to_storage(storage)
+        restored = make_engine()
+        restored.load_from_storage(storage)
+
+        # The retained chain survives: the restored engine re-gossips a
+        # proposal that chain-validates at a fresh peer.
+        exported = restored.get_proposal("s", proposal.proposal_id)
+        assert [v.vote_owner for v in exported.votes] == [
+            v.vote_owner for v in votes
+        ]
+        peer = make_engine()
+        peer.process_incoming_proposal(
+            "s", Proposal.decode(exported.encode()), NOW + 11
+        )
+        assert peer.get_consensus_result("s", proposal.proposal_id) is True
+        # The unretained session round-trips its device tallies.
+        session = restored.export_session("s", plain.proposal_id)
+        assert session.tallies == {owner: True}
+
+    def test_packed_wire_votes_form(self):
+        engine = make_engine()
+        proposal = engine.create_proposal("s", request(n=4), NOW)
+        signers = [random_stub_signer() for _ in range(3)]
+        votes = self._chained_votes(proposal, signers, NOW + 1)
+        encoded = [v.encode() for v in votes]
+        packed = b"".join(encoded)
+        offsets = np.zeros(len(encoded) + 1, np.int64)
+        np.cumsum([len(b) for b in encoded], out=offsets[1:])
+        gids = np.array([engine.voter_gid(v.vote_owner) for v in votes])
+        statuses = engine.ingest_columnar(
+            "s",
+            np.full(len(votes), proposal.proposal_id, np.int64),
+            gids,
+            np.array([v.vote for v in votes]),
+            NOW + 10,
+            wire_votes=(packed, offsets),
+        )
+        assert (statuses == int(StatusCode.OK)).all()
+        exported = engine.get_proposal("s", proposal.proposal_id)
+        assert [v.encode() for v in exported.votes] == encoded
+
+
 class TestLaneBatchResolution:
     def test_mixed_existing_and_new(self):
         from hashgraph_tpu.engine import ProposalPool
