@@ -155,6 +155,21 @@ class TpuConsensusEngine(Generic[Scope]):
                 voter_capacity if voter_capacity is not None else 64,
             )
         self._max_sessions_per_scope = max_sessions_per_scope
+        # Multi-host awareness: a pool exposing local_slots() shards the
+        # slot axis across jax.distributed processes (parallel.MultiHostPool).
+        # The engine then runs SPMD: control-plane calls (create/process
+        # proposal, delete_scope, timeouts) replicated with IDENTICAL
+        # arguments on every process, vote ingest process-local, and every
+        # event emitted by exactly one owning process (see _owns_slot).
+        self._multihost = hasattr(self._pool, "local_slots")
+        if self._multihost:
+            import jax
+
+            # process_index is immutable for the process lifetime; cache it
+            # off the event-gating paths.
+            self._process_zero = jax.process_index() == 0
+        else:
+            self._process_zero = True
         self.tracer = default_tracer
         # One engine-wide reentrant lock: the reference service is fully
         # thread-safe (whole-map RwLocks, src/storage.rs:192-193); the pool's
@@ -368,7 +383,7 @@ class TpuConsensusEngine(Generic[Scope]):
             proposal.clone(), self._scheme, config, now
         )
         # Event before save, as in the reference (src/service.rs:275-277).
-        if transition.is_reached:
+        if transition.is_reached and self._owns_replicated_event():
             self._emit(
                 scope,
                 ConsensusReached(
@@ -456,7 +471,7 @@ class TpuConsensusEngine(Generic[Scope]):
                     sig_verdicts=verdicts[start : start + count] if count else None,
                     chain_error=chain_errors.get(i),
                 )
-                if transition.is_reached:
+                if transition.is_reached and self._owns_replicated_event():
                     self._emit(
                         scope,
                         ConsensusReached(
@@ -651,7 +666,8 @@ class TpuConsensusEngine(Generic[Scope]):
             idxs = [
                 i
                 for i, (scope, vote) in enumerate(items)
-                if (scope, vote.proposal_id) in self._index
+                if (slot := self._index.get((scope, vote.proposal_id))) is not None
+                and (slot < 0 or self._owns_slot(slot))  # skip misrouted rows
             ]
             if idxs:
                 with self.tracer.span("engine.verify_batch", votes=len(idxs)):
@@ -668,6 +684,16 @@ class TpuConsensusEngine(Generic[Scope]):
                 statuses[i] = int(StatusCode.SESSION_NOT_FOUND)
                 continue
             record = self._records[slot]
+            if (
+                self._multihost
+                and record.session is None
+                and not self._owns_slot(slot)
+            ):
+                # Misrouted vote, rejected BEFORE validation: the relay
+                # routes on this status, and a misrouted-but-invalid vote
+                # must look the same as a misrouted-valid one.
+                statuses[i] = int(StatusCode.SESSION_NOT_FOUND)
+                continue
             if not pre_validated:
                 try:
                     validate_vote(
@@ -689,7 +715,7 @@ class TpuConsensusEngine(Generic[Scope]):
                 host_transitions += (
                     was_active and not record.session.state.is_active
                 )
-                if event is not None:
+                if event is not None and self._owns_slot(slot):
                     host_events.append((i, scope, event))
                 continue
             lane = self._pool.lane_for(slot, vote.vote_owner)
@@ -702,6 +728,13 @@ class TpuConsensusEngine(Generic[Scope]):
             dev_rows.append(i)
 
         if not dev_rows:
+            if self._multihost:
+                # Collective cadence: the other processes' batches are part
+                # of the same global dispatch, so an empty one still joins.
+                self._pool.ingest(
+                    np.empty(0, np.int64), np.empty(0, np.int32),
+                    np.empty(0, bool), now,
+                )
             self.tracer.count("engine.votes_accepted", host_accepted)
             self.tracer.count("engine.transitions", host_transitions)
             for _, ev_scope, event in host_events:
@@ -914,7 +947,9 @@ class TpuConsensusEngine(Generic[Scope]):
         batch = len(proposal_ids)
         self.tracer.count("engine.votes_in", batch)
         statuses = np.full(batch, int(StatusCode.SESSION_NOT_FOUND), np.int32)
-        if batch == 0:
+        if batch == 0 and not self._multihost:
+            # Multi-host must fall through: an empty local batch still joins
+            # the fleet's agreed dispatch cadence (allgather + padding below).
             return statuses
 
         pids_sorted, slots_sorted = self._pid_table(scope)
@@ -934,6 +969,19 @@ class TpuConsensusEngine(Generic[Scope]):
         # voter. NOTE: a stale gid used after its id has been recycled by a
         # NEW intern is indistinguishable from the new owner — that misuse
         # is excluded by voter_gid's lifetime contract (re-intern per batch).
+        if self._multihost:
+            # Misrouted rows (device slots another process owns) report the
+            # session as not found on this host; the relay routes by
+            # is_local(). Host-spilled rows (slots < 0) are replicated
+            # control-plane state and apply everywhere. This runs BEFORE the
+            # gid check: a misrouted voter is typically not interned here,
+            # and the relay must see the routing status, not an identity one.
+            lo, hi = self._pool.local_slots()
+            non_local = found & (slots >= 0) & ((slots < lo) | (slots >= hi))
+            if non_local.any():
+                statuses[non_local] = int(StatusCode.SESSION_NOT_FOUND)
+                found = found & ~non_local
+
         bad_gid = ~self._pool.gids_live(voter_gids)
         if bad_gid.any():
             statuses[found & bad_gid] = int(StatusCode.EMPTY_VOTE_OWNER)
@@ -958,38 +1006,53 @@ class TpuConsensusEngine(Generic[Scope]):
                 "engine.transitions",
                 int(was_active and not record.session.state.is_active),
             )
-            if event is not None:
+            if event is not None and self._owns_slot(int(slots[i])):
                 self._emit(scope, event)
 
         dev_rows = np.nonzero(found & (slots >= 0))[0]
-        if dev_rows.size == 0:
-            return statuses
         dslots = slots[dev_rows]
-        lanes = self._pool.lanes_for_batch(dslots, voter_gids[dev_rows])
-        no_lane = lanes < 0
-        if no_lane.any():
-            statuses[dev_rows[no_lane]] = int(StatusCode.VOTER_CAPACITY_EXCEEDED)
-            dev_rows = dev_rows[~no_lane]
-            dslots = dslots[~no_lane]
-            lanes = lanes[~no_lane]
-            if dev_rows.size == 0:
-                return statuses
+        lanes = np.empty(0, np.int32)
+        if dev_rows.size:
+            lanes = self._pool.lanes_for_batch(dslots, voter_gids[dev_rows])
+            no_lane = lanes < 0
+            if no_lane.any():
+                statuses[dev_rows[no_lane]] = int(
+                    StatusCode.VOTER_CAPACITY_EXCEEDED
+                )
+                dev_rows = dev_rows[~no_lane]
+                dslots = dslots[~no_lane]
+                lanes = lanes[~no_lane]
         dvals = values[dev_rows]
 
         # Bounded-depth pipelining: the kernel's scan length is the deepest
         # per-slot chain in a dispatch; segmenting by per-slot occurrence
         # index keeps every dispatch at depth <= max_depth and lets the
         # async queue overlap transfers with device compute.
-        _, _, col, depth = group_batch(dslots)
         seg_members: list[np.ndarray]
-        if depth > max_depth:
-            segs = col // max_depth
-            n_seg = int(segs.max()) + 1
-            order = np.argsort(segs, kind="stable")  # arrival order per segment
-            bounds = np.searchsorted(segs[order], np.arange(1, n_seg))
-            seg_members = np.split(order, bounds)
+        if dev_rows.size:
+            _, _, col, depth = group_batch(dslots)
+            if depth > max_depth:
+                segs = col // max_depth
+                n_seg = int(segs.max()) + 1
+                order = np.argsort(segs, kind="stable")  # arrival order per segment
+                bounds = np.searchsorted(segs[order], np.arange(1, n_seg))
+                seg_members = np.split(order, bounds)
+            else:
+                seg_members = [np.arange(dev_rows.size)]
         else:
-            seg_members = [np.arange(dev_rows.size)]
+            seg_members = []
+        if self._multihost:
+            # Collective cadence: every process must issue the same number
+            # of dispatches this call, empty ones included.
+            from jax.experimental import multihost_utils
+
+            agreed = multihost_utils.process_allgather(
+                np.array([len(seg_members)], np.int64)
+            )
+            for _ in range(int(np.max(agreed)) - len(seg_members)):
+                seg_members.append(np.empty(0, np.int64))
+        if not seg_members:
+            return statuses
 
         pendings = []
         for members in seg_members:
@@ -1150,20 +1213,33 @@ class TpuConsensusEngine(Generic[Scope]):
         if slot is None:
             raise SessionNotFound()
         record = self._records[slot]
+        owned = self._owns_slot(slot)
         if record.session is not None:
             new_state = self._host_timeout(record, now)
         else:
-            [(_, new_state)] = self._pool.timeout([slot])
+            transitions = self._pool.timeout([slot])
+            if transitions:
+                [(_, new_state)] = transitions
+            else:
+                # Multi-host collective: this process joined the dispatch
+                # but another process owns the slot; pool.timeout synced the
+                # state mirror, so the result is readable (and the owner
+                # emitted the event).
+                new_state = self._pool.state_of(slot)
         if new_state in (STATE_REACHED_YES, STATE_REACHED_NO):
             result = new_state == STATE_REACHED_YES
-            self._emit(
-                scope,
-                ConsensusReached(
-                    proposal_id=proposal_id, result=result, timestamp=now
-                ),
-            )
+            if owned:
+                self._emit(
+                    scope,
+                    ConsensusReached(
+                        proposal_id=proposal_id, result=result, timestamp=now
+                    ),
+                )
             return result
-        self._emit(scope, ConsensusFailedEvent(proposal_id=proposal_id, timestamp=now))
+        if owned:
+            self._emit(
+                scope, ConsensusFailedEvent(proposal_id=proposal_id, timestamp=now)
+            )
         raise InsufficientVotesAtTimeout()
 
     def sweep_timeouts(self, now: int) -> list[tuple[Scope, int, bool | None]]:
@@ -1174,7 +1250,14 @@ class TpuConsensusEngine(Generic[Scope]):
         session and emits the same events as per-session timeouts. Only
         ACTIVE sessions are swept: a FAILED session's tallies are frozen (the
         ingest kernel rejects votes on non-ACTIVE slots) so re-sweeping it
-        would deterministically re-fail and re-emit forever."""
+        would deterministically re-fail and re-emit forever.
+
+        Multi-host: collective (same cadence everywhere). The state mirror
+        is synced first so every process computes the IDENTICAL expired set
+        — remote slots' mirrored states lag between collectives by design
+        (zero DCN on the ingest path)."""
+        if self._multihost:
+            self._pool.sync_states()
         expired: list[int] = []
         host_expired: list[int] = []
         for slot, record in self._records.items():
@@ -1190,11 +1273,20 @@ class TpuConsensusEngine(Generic[Scope]):
         self.tracer.count("engine.timeout_sweeps")
         self.tracer.count("engine.timeouts_fired", len(expired) + len(host_expired))
         out: list[tuple[Scope, int, bool | None]] = []
-        swept = self._pool.timeout(expired) + [
-            (slot, self._host_timeout(self._records[slot], now))
+        # pool.timeout is collective on a multi-host pool and returns only
+        # this process's slots; host-spilled sessions advance identically on
+        # every process but their events/results belong to process 0.
+        swept = [(slot, st, True) for slot, st in self._pool.timeout(expired)] + [
+            (
+                slot,
+                self._host_timeout(self._records[slot], now),
+                self._owns_slot(slot),
+            )
             for slot in host_expired
         ]
-        for slot, new_state in swept:
+        for slot, new_state, owned in swept:
+            if not owned:
+                continue
             record = self._records[slot]
             pid = record.proposal.proposal_id
             if new_state in (STATE_REACHED_YES, STATE_REACHED_NO):
@@ -1502,6 +1594,41 @@ class TpuConsensusEngine(Generic[Scope]):
 
     def _emit(self, scope: Scope, event: ConsensusEvent) -> None:
         self._event_bus.publish(scope, event)
+
+    # ── Multi-host ownership (parallel/multihost.py contract) ──────────
+
+    def _owns_replicated_event(self) -> bool:
+        """Events arising from replicated, not-slot-owned work — proposal
+        loads and host-spilled sessions — are emitted by process 0 only in
+        multi-host mode, so a fleet of engine front-ends never
+        double-publishes."""
+        return self._process_zero
+
+    def _owns_slot(self, slot: int) -> bool:
+        """EVENT-emission ownership of one session. Single-host pools own
+        everything. On a multi-host pool a device slot belongs to the
+        process whose local range holds it; host-spilled sessions
+        (replicated on every process) belong to process 0."""
+        if not self._multihost:
+            return True
+        if slot < 0:
+            return self._process_zero
+        lo, hi = self._pool.local_slots()
+        return lo <= slot < hi
+
+    def is_local(self, scope: Scope, proposal_id: int) -> bool:
+        """Routing query for multi-host embedders: should THIS process
+        apply the session's votes? Device-pooled sessions: the slot-owning
+        process only (route to it). Host-spilled sessions are replicated
+        control-plane state: True on EVERY process — the relay must deliver
+        their votes fleet-wide (like proposals) so the replicas advance
+        identically; their events still come from process 0 only."""
+        slot = self._index.get((scope, proposal_id))
+        if slot is None:
+            raise SessionNotFound()
+        if slot < 0:
+            return True
+        return self._owns_slot(slot)
 
 
 def _synchronized(fn):

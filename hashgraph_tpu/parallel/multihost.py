@@ -19,7 +19,12 @@ Execution model (the scaling-book recipe applied to consensus):
   every process compiles the same program.
 - **Events are emitted by the owning process only** (ingest statuses and
   timeout transitions are returned for local slots), so a fleet of engine
-  front-ends never double-publishes.
+  front-ends never double-publishes — asserted end-to-end by the 2-process
+  ``TpuConsensusEngine``-on-``MultiHostPool`` test
+  (tests/test_multihost.py::test_two_process_engine_on_multihost_pool),
+  which drives the full engine surface: replicated control plane,
+  local-only scalar + columnar ingest with agreed dispatch cadence,
+  misrouted-vote rejection, collective timeouts and sweeps.
 - **Signatures verify where votes arrive** (host CPU, native runtime), so
   adding hosts scales verification linearly with the fleet, independent of
   the TPU topology.
