@@ -229,7 +229,46 @@ class TpuConsensusEngine(Generic[Scope]):
         """Collision-proof a locally-generated proposal id against live
         sessions in this scope and (for batch creation) earlier proposals in
         the same batch. Policy and rationale: protocol.regenerate_until_unique.
+
+        Multi-host: uuid-random ids would differ per process and silently
+        de-sync the replicated control plane, so the id is derived
+        deterministically from the proposal's content plus the (replicated)
+        per-scope population — identical create_proposal calls then mint the
+        identical pid on every process.
         """
+        if self._multihost:
+            import hashlib
+
+            taken_set = taken or set()
+            seq = len(self._scopes.get(scope, []))
+            salt = 0
+            while True:
+                digest = hashlib.sha256(
+                    b"|".join(
+                        [
+                            repr(scope).encode(),
+                            proposal.name.encode(),
+                            proposal.payload,
+                            proposal.proposal_owner,
+                            str(
+                                (
+                                    proposal.expected_voters_count,
+                                    proposal.timestamp,
+                                    seq,
+                                    salt,
+                                )
+                            ).encode(),
+                        ]
+                    )
+                ).digest()
+                pid = int.from_bytes(digest[:4], "little") ^ int.from_bytes(
+                    digest[4:8], "little"
+                )
+                if pid and (scope, pid) not in self._index and pid not in taken_set:
+                    proposal.proposal_id = pid
+                    return
+                salt += 1
+                self.tracer.count("engine.pid_collisions")
         collisions = regenerate_until_unique(
             proposal,
             lambda pid: (scope, pid) in self._index

@@ -14,7 +14,9 @@ import sys
 
 import pytest
 
-_WORKER = r"""
+# Shared bootstrap: 2 processes x 2 virtual CPU devices, one jax.distributed
+# fleet, repo importable (spawned with cwd = repo root).
+_PREAMBLE = r"""
 import os, sys
 
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -32,8 +34,10 @@ jax.distributed.initialize(
 assert jax.process_count() == 2, jax.process_count()
 assert len(jax.devices()) == 4, len(jax.devices())
 assert len(jax.local_devices()) == 2
+sys.path.insert(0, os.getcwd())
+"""
 
-sys.path.insert(0, os.getcwd())  # spawned with cwd = repo root
+_WORKER = _PREAMBLE + r"""
 from hashgraph_tpu.ops.decide import (
     STATE_ACTIVE,
     STATE_REACHED_NO,
@@ -102,24 +106,14 @@ print(f"MULTIHOST_OK p{process_id} slots={mine}")
 """
 
 
-_ENGINE_WORKER = r"""
-import os, sys
-
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-import numpy as np
-
-process_id = int(sys.argv[1])
-coordinator = sys.argv[2]
-
-jax.distributed.initialize(
-    coordinator_address=coordinator, num_processes=2, process_id=process_id
+_ENGINE_WORKER = _PREAMBLE + r"""
+from hashgraph_tpu import (
+    CreateProposalRequest,
+    Proposal,
+    StatusCode,
+    StubConsensusSigner,
+    build_vote,
 )
-sys.path.insert(0, os.getcwd())
-
-from hashgraph_tpu import Proposal, StubConsensusSigner, build_vote, StatusCode
 from hashgraph_tpu.engine import TpuConsensusEngine
 from hashgraph_tpu.errors import InsufficientVotesAtTimeout
 from hashgraph_tpu.parallel import MultiHostPool, distributed_consensus_mesh
@@ -156,6 +150,25 @@ P = 8
 pids = [1000 + i for i in range(P)]
 for pid in pids:
     engine.process_incoming_proposal("s", proposal(pid), NOW)
+
+# Replicated create_proposal must mint the SAME pid on every process
+# (deterministic content-derived ids in multi-host mode) — otherwise the
+# SPMD control plane silently de-syncs.
+created = engine.create_proposal(
+    "create-check",
+    CreateProposalRequest(
+        name="replicated", payload=b"x", proposal_owner=b"o" * 20,
+        expected_voters_count=3, expiration_timestamp=60,
+        liveness_criteria_yes=True,
+    ),
+    NOW,
+)
+from jax.experimental import multihost_utils
+agreed_pid = multihost_utils.process_allgather(
+    np.array([created.proposal_id], np.int64)
+)
+assert int(np.min(agreed_pid)) == int(np.max(agreed_pid)), agreed_pid
+engine.delete_scope("create-check")
 local_pids = [pid for pid in pids if engine.is_local("s", pid)]
 assert 0 < len(local_pids) < P, local_pids  # both processes own some
 
