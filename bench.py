@@ -204,6 +204,203 @@ def run_engine_bench(
     }
 
 
+def run_engine_lanes1024(
+    p_count: int = 12_288, v_count: int = 1024, cycles: int = 3
+) -> dict:
+    """Engine-level north-star shape: 12k concurrent proposals × 1024 voter
+    lanes under P2P round caps, driven through the FULL service surface
+    (batch creation, pid resolution, lane resolution, round bookkeeping,
+    statuses, events) via the columnar API — the per-chip slice of "100k
+    concurrent 1024-voter proposals" measured at the layer embedders call,
+    not the raw pool."""
+    import jax
+
+    from hashgraph_tpu import CreateProposalRequest, StubConsensusSigner
+    from hashgraph_tpu import ScopeConfigBuilder
+    from hashgraph_tpu.engine import TpuConsensusEngine
+
+    rng = np.random.default_rng(13)
+    now = 1_700_000_000
+    engine = TpuConsensusEngine(
+        StubConsensusSigner(b"\x01" * 20),
+        capacity=p_count,
+        voter_capacity=v_count,
+        max_sessions_per_scope=p_count + 1,
+    )
+    fill = 672  # ceil(2n/3)=683 P2P vote cap; stay under mid-stream decisions
+    requests = [
+        CreateProposalRequest(
+            name="p",
+            payload=b"",
+            proposal_owner=b"o",
+            expected_voters_count=v_count,
+            expiration_timestamp=10_000,
+            liveness_criteria_yes=True,
+        )
+        for _ in range(p_count)
+    ]
+    gids = np.array(
+        [
+            engine.voter_gid(bytes([1 + (i % 250), i // 250]) + b"\x00" * 18)
+            for i in range(fill)
+        ],
+        np.int64,
+    )
+    # One fresh (slot, gid) stream per cycle: proposal-major, arrival order
+    # = lane order; every pair is first-occurrence so lane resolution stays
+    # on the vectorized fresh-assignment path.
+    col_gids = np.tile(gids, p_count)
+    col_vals = rng.random(p_count * fill) < 0.5
+
+    ingest_rates, create_rates = [], []
+    for cycle in range(cycles + 1):  # first is compile warmup
+        engine.delete_scope("s")
+        engine.set_scope_config("s", ScopeConfigBuilder().p2p_preset().build())
+        t0 = time.perf_counter()
+        proposals = engine.create_proposals("s", requests, now)
+        t1 = time.perf_counter()
+        pids = np.fromiter((p.proposal_id for p in proposals), np.int64, p_count)
+        col_pids = np.repeat(pids, fill)
+        t2 = time.perf_counter()
+        statuses = engine.ingest_columnar("s", col_pids, col_gids, col_vals, now)
+        t3 = time.perf_counter()
+        if cycle == 0:
+            ok = int(np.sum(statuses == 0))
+            assert ok == p_count * fill, (ok, p_count * fill)
+        else:
+            create_rates.append(p_count / (t1 - t0))
+            ingest_rates.append(p_count * fill / (t3 - t2))
+    ingest_rates.sort()
+    create_rates.sort()
+    throughput = ingest_rates[len(ingest_rates) // 2]
+    return {
+        "metric": "engine_lanes1024_ingest_throughput",
+        "value": round(throughput, 1),
+        "unit": "votes/sec",
+        "vs_baseline": round(throughput / 1_000_000, 4),
+        "detail": {
+            "proposals": p_count,
+            "voter_lanes": v_count,
+            "network_type": "p2p",
+            "votes_per_cycle": p_count * fill,
+            "ingest_rates": [round(r, 1) for r in ingest_rates],
+            "proposal_creation_rate": round(
+                create_rates[len(create_rates) // 2], 1
+            ),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
+def run_engine_config5(
+    scopes: int = 256,
+    proposals_per_scope: int = 128,
+    v_count: int = 48,
+    waves: int = 4,
+) -> dict:
+    """Engine-level config 5: mixed-scope streaming churn. Every wave
+    registers 256 scopes' worth of fresh proposals (half gossipsub, half
+    P2P scope configs), streams a shuffled mixed-scope vote batch through
+    ingest_columnar_multi (one fused device pipeline, per-scope work =
+    one table probe each), then deletes every scope — live-deployment
+    session churn through the real service surface."""
+    import jax
+
+    from hashgraph_tpu import CreateProposalRequest, StubConsensusSigner
+    from hashgraph_tpu import ScopeConfigBuilder
+    from hashgraph_tpu.engine import TpuConsensusEngine
+
+    rng = np.random.default_rng(29)
+    now = 1_700_000_000
+    p_count = scopes * proposals_per_scope
+    engine = TpuConsensusEngine(
+        StubConsensusSigner(b"\x01" * 20),
+        capacity=p_count,
+        voter_capacity=v_count,
+        max_sessions_per_scope=proposals_per_scope + 1,
+    )
+    scope_names = [f"s{i}" for i in range(scopes)]
+
+    def set_configs() -> None:
+        # delete_scope drops the scope config with the sessions, so churn
+        # waves must re-establish the mixed gossip/P2P split every wave.
+        for i, scope in enumerate(scope_names):
+            builder = ScopeConfigBuilder()
+            builder = (
+                builder.p2p_preset() if i % 2 else builder.gossipsub_preset()
+            )
+            engine.set_scope_config(scope, builder.build())
+
+    gids = np.array(
+        [engine.voter_gid(bytes([1 + i]) * 20) for i in range(v_count)],
+        np.int64,
+    )
+    requests = [
+        CreateProposalRequest(
+            name="p",
+            payload=b"",
+            proposal_owner=b"o",
+            expected_voters_count=v_count,
+            expiration_timestamp=10_000,
+            liveness_criteria_yes=bool(rng.integers(2)),
+        )
+        for _ in range(proposals_per_scope)
+    ]
+
+    def run_wave(wave: int) -> tuple[int, int]:
+        """Returns (votes_applied, proposals_registered)."""
+        set_configs()
+        all_pids = []
+        scope_of = []
+        for k, scope in enumerate(scope_names):
+            proposals = engine.create_proposals(scope, requests, now)
+            all_pids.extend(p.proposal_id for p in proposals)
+            scope_of.extend([k] * len(proposals))
+        pids = np.array(all_pids, np.int64)
+        sidx = np.array(scope_of, np.int64)
+        # 70% participation, proposal-major arrival order, scope-shuffled
+        # at proposal granularity (within-proposal order must hold).
+        present = int(v_count * 0.7)
+        order = rng.permutation(p_count)
+        col_pids = np.repeat(pids[order], present)
+        col_sidx = np.repeat(sidx[order], present)
+        col_gids = np.tile(gids[:present], p_count)
+        col_vals = rng.random(p_count * present) < 0.55
+        statuses = engine.ingest_columnar_multi(
+            scope_names, col_sidx, col_pids, col_gids, col_vals, now
+        )
+        votes = len(statuses)
+        for scope in scope_names:
+            engine.delete_scope(scope)
+        return votes, p_count
+
+    run_wave(-1)  # warmup/compile
+    total_votes = total_proposals = 0
+    start = time.perf_counter()
+    for wave in range(waves):
+        votes, registered = run_wave(wave)
+        total_votes += votes
+        total_proposals += registered
+    elapsed = time.perf_counter() - start
+    throughput = total_votes / elapsed
+    return {
+        "metric": "engine_mixed_scope_churn_throughput",
+        "value": round(throughput, 1),
+        "unit": "votes/sec",
+        "vs_baseline": round(throughput / 1_000_000, 4),
+        "detail": {
+            "scopes": scopes,
+            "proposals_per_wave": p_count,
+            "waves": waves,
+            "proposals_churned": total_proposals,
+            "votes": total_votes,
+            "seconds": round(elapsed, 3),
+            "proposals_per_sec": round(total_proposals / elapsed, 1),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
 def run_lanes1024(p_count: int = 12_288, v_count: int = 1024) -> dict:
     """1024-voter-lane pool run: ~the per-chip slice of 100k concurrent
     1024-voter proposals on a v5e-8 (BASELINE north-star shape)."""
@@ -303,7 +500,7 @@ def run_crypto(count: int = 4096) -> dict:
     }
 
 
-def run_validated(p_count: int = 512, v_count: int = 16) -> dict:
+def run_validated(p_count: int = 1024, v_count: int = 16) -> dict:
     """End-to-end validated ingest: real EIP-191 ECDSA signatures through
     host validation (structural checks + hash recompute + native batched
     recover) into the columnar device path — the full
@@ -643,18 +840,31 @@ def run_config5(
 
 def run_default() -> dict:
     """The driver-visible sweep: engine-level config 3 as the headline,
-    every other BASELINE shape in ``detail`` (one JSON line total)."""
-    engine = run_engine_bench()
+    every other BASELINE shape in ``detail`` (one JSON line total).
+
+    The headline is the MEDIAN of three full engine-bench repetitions
+    (each itself a median over per-cycle rates), with the cross-repetition
+    spread reported alongside — the tunneled TPU link jitters up to 2x
+    between identical runs, and a claim that can't survive a bad tunnel
+    day isn't a claim (BENCHMARKS.md)."""
+    reps = [run_engine_bench() for _ in range(3)]
+    values = sorted(r["value"] for r in reps)
+    engine = next(r for r in reps if r["value"] == values[1])
+    spread_pct = 100.0 * (values[-1] - values[0]) / values[1]
     sections = {
         "pool_level": run_bench(),
         "config2": run_config2(),
         "lanes1024": run_lanes1024(),
+        "engine_lanes1024": run_engine_lanes1024(),
         "validated": run_validated(),
         "crypto": run_crypto(),
         "config4": run_config4(),
         "config5": run_config5(),
+        "engine_config5": run_engine_config5(),
     }
     detail = dict(engine["detail"])
+    detail["headline_repetitions"] = values
+    detail["headline_spread_pct"] = round(spread_pct, 1)
     for name, result in sections.items():
         detail[name] = {
             "metric": result["metric"],
@@ -682,7 +892,9 @@ if __name__ == "__main__":
         "config2": run_config2,
         "config4": run_config4,
         "config5": run_config5,
+        "engine_config5": run_engine_config5,
         "lanes1024": run_lanes1024,
+        "engine_lanes1024": run_engine_lanes1024,
         "crypto": run_crypto,
         "validated": run_validated,
         "default": run_default,
@@ -693,10 +905,12 @@ if __name__ == "__main__":
             "pool",
             "config2",
             "lanes1024",
+            "engine_lanes1024",
             "validated",
             "crypto",
             "config4",
             "config5",
+            "engine_config5",
         ):
             print(json.dumps(runners[name]()))
     else:

@@ -277,6 +277,32 @@ class TpuConsensusEngine(Generic[Scope]):
         if collisions:
             self.tracer.count("engine.pid_collisions", collisions)
 
+    def _draw_unique_pids(self, scope: Scope, count: int) -> np.ndarray:
+        """Batch id draw: one urandom read, vectorized collision rejection
+        against the scope's live pids and within the batch itself."""
+        import os as _os
+
+        existing, _ = self._pid_table(scope)
+        ids = np.frombuffer(_os.urandom(4 * count), dtype=np.uint32).astype(
+            np.int64
+        )
+        for _ in range(64):
+            bad = np.isin(ids, existing)
+            _, first_idx, inverse, counts = np.unique(
+                ids, return_index=True, return_inverse=True, return_counts=True
+            )
+            is_first = np.zeros(count, bool)
+            is_first[first_idx] = True
+            bad |= (counts[inverse] > 1) & ~is_first
+            n_bad = int(bad.sum())
+            if n_bad == 0:
+                return ids
+            self.tracer.count("engine.pid_collisions", n_bad)
+            ids[bad] = np.frombuffer(
+                _os.urandom(4 * n_bad), dtype=np.uint32
+            ).astype(np.int64)
+        raise RuntimeError("could not draw unique proposal ids")  # pragma: no cover
+
     def create_proposals(
         self,
         scope: Scope,
@@ -305,14 +331,37 @@ class TpuConsensusEngine(Generic[Scope]):
 
         proposals: list[Proposal] = []
         configs: list[ConsensusConfig] = []
+        # Single-host fast path: draw the whole batch's proposal ids in one
+        # urandom read with vectorized collision checks (same id space and
+        # uniqueness policy as generate_id/regenerate_until_unique, minus
+        # the per-proposal uuid4 cost). Multi-host keeps the deterministic
+        # per-proposal derivation (_ensure_unique_pid).
+        batch_ids = (
+            None if self._multihost else self._draw_unique_pids(scope, len(requests))
+        )
+        # Config resolution is identical for requests sharing (expiration,
+        # liveness) when no per-proposal override exists — memoize per batch.
+        cfg_cache: dict = {}
         batch_pids: set[int] = set()
-        for request in requests:
-            proposal = request.into_proposal(now)
-            self._ensure_unique_pid(scope, proposal, taken=batch_pids)
-            batch_pids.add(proposal.proposal_id)
+        for idx, request in enumerate(requests):
+            proposal = request.into_proposal(
+                now, pid=None if batch_ids is None else int(batch_ids[idx])
+            )
+            if batch_ids is None:
+                self._ensure_unique_pid(scope, proposal, taken=batch_pids)
+                batch_pids.add(proposal.proposal_id)
             validate_proposal_timestamp(proposal.expiration_timestamp, now)
             proposals.append(proposal)
-            configs.append(self._resolve_config(scope, config, proposal))
+            key = (
+                proposal.expiration_timestamp,
+                proposal.liveness_criteria_yes,
+                proposal.timestamp,
+            )
+            resolved = cfg_cache.get(key)
+            if resolved is None:
+                resolved = self._resolve_config(scope, config, proposal)
+                cfg_cache[key] = resolved
+            configs.append(resolved)
 
         free = self._pool.free_slots
         fit_idx: list[int] = []
@@ -969,6 +1018,54 @@ class TpuConsensusEngine(Generic[Scope]):
             seg_blob = blob[int(out_off[lo]) : int(out_off[hi])].tobytes()
             self._records[int(slot)].retained_wire.append((seg_blob, seg_off))
 
+    def ingest_columnar_multi(
+        self,
+        scopes: list,
+        scope_idx: np.ndarray,
+        proposal_ids: np.ndarray,
+        voter_gids: np.ndarray,
+        values: np.ndarray,
+        now: int,
+        max_depth: int = 8,
+    ) -> np.ndarray:
+        """Mixed-scope columnar ingest: one fused device pipeline across
+        many scopes (BASELINE config-5 churn shape). ``scopes`` lists the
+        distinct scopes; ``scope_idx`` (int32, per row) indexes into it.
+        Per-scope work is only the proposal-id resolution — one searchsorted
+        per scope — so a 256-scope stream costs 256 cheap table probes, not
+        256 device dispatches; lanes, dispatch segmentation, statuses, and
+        events are shared with :meth:`ingest_columnar`."""
+        proposal_ids = np.asarray(proposal_ids, np.int64)
+        scope_idx = np.asarray(scope_idx, np.int64)
+        voter_gids = np.asarray(voter_gids, np.int64)
+        values = np.asarray(values, bool)
+        batch = len(proposal_ids)
+        self.tracer.count("engine.votes_in", batch)
+        statuses = np.full(batch, int(StatusCode.SESSION_NOT_FOUND), np.int32)
+        if batch == 0 and not self._multihost:
+            return statuses
+        found = np.zeros(batch, bool)
+        slots = np.zeros(batch, np.int64)
+        # One stable sort groups the rows of every scope (O(batch log batch)
+        # total, not one full scan per scope).
+        order = np.argsort(scope_idx, kind="stable")
+        bounds = np.searchsorted(scope_idx[order], np.arange(len(scopes) + 1))
+        for k, scope in enumerate(scopes):
+            rows = order[bounds[k] : bounds[k + 1]]
+            if rows.size == 0:
+                continue
+            pids_sorted, slots_sorted = self._pid_table(scope)
+            if len(pids_sorted) == 0:
+                continue
+            pos = np.searchsorted(pids_sorted, proposal_ids[rows])
+            pos = np.clip(pos, 0, len(pids_sorted) - 1)
+            hit = pids_sorted[pos] == proposal_ids[rows]
+            found[rows] = hit
+            slots[rows] = np.where(hit, slots_sorted[pos], 0)
+        return self._columnar_apply(
+            slots, found, voter_gids, values, now, max_depth, statuses
+        )
+
     def _ingest_columnar_apply(
         self,
         scope: Scope,
@@ -978,8 +1075,6 @@ class TpuConsensusEngine(Generic[Scope]):
         now: int,
         max_depth: int = 8,
     ) -> np.ndarray:
-        from .pool import group_batch
-
         proposal_ids = np.asarray(proposal_ids, np.int64)
         voter_gids = np.asarray(voter_gids, np.int64)
         values = np.asarray(values, bool)
@@ -988,7 +1083,8 @@ class TpuConsensusEngine(Generic[Scope]):
         statuses = np.full(batch, int(StatusCode.SESSION_NOT_FOUND), np.int32)
         if batch == 0 and not self._multihost:
             # Multi-host must fall through: an empty local batch still joins
-            # the fleet's agreed dispatch cadence (allgather + padding below).
+            # the fleet's agreed dispatch cadence (allgather + padding in
+            # _columnar_apply).
             return statuses
 
         pids_sorted, slots_sorted = self._pid_table(scope)
@@ -1000,6 +1096,25 @@ class TpuConsensusEngine(Generic[Scope]):
         else:
             found = np.zeros(batch, bool)
             slots = np.zeros(batch, np.int64)
+        return self._columnar_apply(
+            slots, found, voter_gids, values, now, max_depth, statuses
+        )
+
+    def _columnar_apply(
+        self,
+        slots: np.ndarray,
+        found: np.ndarray,
+        voter_gids: np.ndarray,
+        values: np.ndarray,
+        now: int,
+        max_depth: int,
+        statuses: np.ndarray,
+    ) -> np.ndarray:
+        """Slot-resolved columnar pipeline shared by the single- and
+        multi-scope entry points: gid/locality filters, host-spill tallies,
+        lane resolution, bounded-depth pipelined device dispatches, round
+        bookkeeping, and event emission."""
+        from .pool import group_batch
 
         # Gids must be LIVE interned identities (voter_gid): out-of-range and
         # freed-but-unclaimed ids get a typed per-row status on BOTH
@@ -1046,7 +1161,7 @@ class TpuConsensusEngine(Generic[Scope]):
                 int(was_active and not record.session.state.is_active),
             )
             if event is not None and self._owns_slot(int(slots[i])):
-                self._emit(scope, event)
+                self._emit(record.scope, event)
 
         dev_rows = np.nonzero(found & (slots >= 0))[0]
         dslots = slots[dev_rows]
@@ -1688,6 +1803,7 @@ for _name in (
     "process_incoming_proposal",
     "ingest_proposals",
     "ingest_columnar",
+    "ingest_columnar_multi",
     "voter_gid",
     "cast_vote",
     "cast_vote_and_get_proposal",

@@ -82,13 +82,15 @@ class CreateProposalRequest:
         validate_expected_voters_count(self.expected_voters_count)
         validate_timeout(self.expiration_timestamp)
 
-    def into_proposal(self, now: int) -> Proposal:
+    def into_proposal(self, now: int, pid: int | None = None) -> Proposal:
         """Stamp ``now``, generate an id, derive absolute expiration with
-        saturating add (reference: src/types.rs:90-105)."""
+        saturating add (reference: src/types.rs:90-105). ``pid`` lets batch
+        creators supply a pre-drawn id (same id space, one urandom read for
+        the whole batch) instead of paying a uuid4 per proposal."""
         return Proposal(
             name=self.name,
             payload=self.payload,
-            proposal_id=generate_id(),
+            proposal_id=generate_id() if pid is None else pid,
             proposal_owner=self.proposal_owner,
             votes=[],
             expected_voters_count=self.expected_voters_count,

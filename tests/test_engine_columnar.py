@@ -388,6 +388,102 @@ class TestColumnarSpillIntegrity:
         }
 
 
+class TestMultiScopeColumnar:
+    def test_multi_scope_parity_with_per_scope_calls(self):
+        """ingest_columnar_multi over N scopes must produce exactly the
+        per-row statuses and final states of N separate single-scope calls
+        on an identically-prepared engine."""
+        rng = np.random.default_rng(5)
+        scopes = [f"sc{i}" for i in range(6)]
+        owners = [bytes([60 + v]) * 20 for v in range(4)]
+
+        def build(engine):
+            # Intern voters first, identical order: both engines' fresh
+            # registries then assign identical gids.
+            for owner in owners:
+                engine.voter_gid(owner)
+            pids = {}
+            for scope in scopes:
+                proposals = engine.create_proposals(
+                    scope, [request(n=4) for _ in range(8)], NOW
+                )
+                pids[scope] = [p.proposal_id for p in proposals]
+            return pids
+
+        def vote_columns(engine, pids):
+            rows = []
+            for k, scope in enumerate(scopes):
+                for pid in pids[scope]:
+                    for v in range(3):
+                        rows.append(
+                            (k, pid, engine.voter_gid(owners[v]),
+                             bool(rng.integers(2)))
+                        )
+            order = rng.permutation(len(rows))
+            rows = [rows[i] for i in order]
+            return (
+                np.array([r[0] for r in rows], np.int64),
+                np.array([r[1] for r in rows], np.int64),
+                np.array([r[2] for r in rows], np.int64),
+                np.array([r[3] for r in rows], bool),
+            )
+
+        eng_multi = make_engine()
+        pids_m = build(eng_multi)
+        sidx, pid_col, gid_col, val_col = vote_columns(eng_multi, pids_m)
+        multi_status = eng_multi.ingest_columnar_multi(
+            scopes, sidx, pid_col, gid_col, val_col, NOW + 1
+        )
+
+        eng_single = make_engine()
+        pids_s = build(eng_single)
+        # Map multi pids -> single pids positionally per scope.
+        remap = {}
+        for scope in scopes:
+            for pm, ps in zip(pids_m[scope], pids_s[scope]):
+                remap[pm] = ps
+        single_status = np.empty_like(multi_status)
+        for k, scope in enumerate(scopes):
+            rows = np.nonzero(sidx == k)[0]
+            single_status[rows] = eng_single.ingest_columnar(
+                scope,
+                np.array([remap[p] for p in pid_col[rows]], np.int64),
+                gid_col[rows],
+                val_col[rows],
+                NOW + 1,
+            )
+        assert (multi_status == single_status).all()
+        for k, scope in enumerate(scopes):
+            for pm in pids_m[scope]:
+                try:
+                    rm = eng_multi.get_consensus_result(scope, pm)
+                except Exception as exc:  # ConsensusFailed parity
+                    rm = type(exc).__name__
+                try:
+                    rs = eng_single.get_consensus_result(scope, remap[pm])
+                except Exception as exc:
+                    rs = type(exc).__name__
+                assert rm == rs, (scope, pm, rm, rs)
+
+    def test_multi_scope_unknown_scope_and_pid(self):
+        engine = make_engine()
+        [p] = engine.create_proposals("known", [request(n=4)], NOW)
+        gid = engine.voter_gid(b"\x77" * 20)
+        statuses = engine.ingest_columnar_multi(
+            ["known", "ghost"],
+            np.array([0, 1, 0]),
+            np.array([p.proposal_id, p.proposal_id, 999], np.int64),
+            np.array([gid] * 3),
+            np.ones(3, bool),
+            NOW + 1,
+        )
+        assert statuses.tolist() == [
+            int(StatusCode.OK),
+            int(StatusCode.SESSION_NOT_FOUND),  # scope exists elsewhere only
+            int(StatusCode.SESSION_NOT_FOUND),  # unknown pid
+        ]
+
+
 class TestWireRetention:
     """Opt-in wire_votes retention closes the columnar chain gap: a proposal
     ingested columnar can be re-gossiped and chain-validates at a peer

@@ -81,16 +81,34 @@ def test_engine_same_id_in_different_scopes_is_not_a_collision(collide):
     assert pa.proposal_id == 42 and pb.proposal_id == 42
 
 
-def test_engine_create_proposals_batch_regenerates_within_batch(collide):
+def test_engine_create_proposals_batch_regenerates_within_batch(collide, monkeypatch):
+    """The batch path draws ids in one urandom read with vectorized
+    rejection (against live pids AND intra-batch duplicates); force the
+    first draw to collide wholesale and check every id is re-drawn."""
+    import os
+
+    draws = [b"\x2a\x00\x00\x00" * 5]  # every id = 42, all colliding
+    counter = itertools.count(200)
+
+    def fake_urandom(n):
+        if draws:
+            return draws.pop(0)
+        return b"".join(
+            int(next(counter)).to_bytes(4, "little") for _ in range(n // 4)
+        )
+
+    monkeypatch.setattr(os, "urandom", fake_urandom)
     engine = make_engine()
+    engine.create_proposal("s", request(), NOW)  # scalar path takes id 42
     batch = engine.create_proposals("s", [request() for _ in range(5)], NOW)
     pids = [p.proposal_id for p in batch]
     assert len(set(pids)) == 5, pids
-    assert pids[0] == 42 and pids[1:] == [100, 101, 102, 103]
+    assert 42 not in pids
+    assert set(pids) == {200, 201, 202, 203, 204}, pids
     # And against pre-existing sessions, not just batch-internal.
     batch2 = engine.create_proposals("s", [request() for _ in range(2)], NOW)
     pids2 = [p.proposal_id for p in batch2]
-    assert len(set(pids + pids2)) == 7
+    assert len(set(pids + pids2 + [42])) == 8
     engine.delete_scope("s")
 
 
