@@ -369,6 +369,11 @@ def run_engine_config5(
         statuses = engine.ingest_columnar_multi(
             scope_names, col_sidx, col_pids, col_gids, col_vals, now
         )
+        if wave < 0:
+            # Warmup wave doubles as the correctness gate: a resolution
+            # regression must fail the bench, not get timed as throughput.
+            applied = int(np.sum((statuses == 0) | (statuses == 28)))
+            assert applied == len(statuses), (applied, len(statuses))
         votes = len(statuses)
         for scope in scope_names:
             engine.delete_scope(scope)

@@ -465,6 +465,32 @@ class TestMultiScopeColumnar:
                     rs = type(exc).__name__
                 assert rm == rs, (scope, pm, rm, rs)
 
+    def test_negative_pid_never_matches_hash_sentinel(self):
+        """pid -1 must resolve to SESSION_NOT_FOUND, not alias the
+        _PidLookup empty-bucket sentinel onto slot 0 (a -1 row once cast a
+        vote into whatever session occupied slot 0, across scopes)."""
+        engine = make_engine()
+        [p] = engine.create_proposals("A", [request(n=4)], NOW)
+        gid = engine.voter_gid(b"\x66" * 20)
+        st = engine.ingest_columnar(
+            "B", np.array([-1]), np.array([gid]), np.array([True]), NOW
+        )
+        assert st.tolist() == [int(StatusCode.SESSION_NOT_FOUND)]
+        st = engine.ingest_columnar(
+            "A",
+            np.array([-1, p.proposal_id, 2**63 - 1]),
+            np.array([gid] * 3),
+            np.array([True] * 3),
+            NOW,
+        )
+        assert st.tolist() == [
+            int(StatusCode.SESSION_NOT_FOUND),
+            int(StatusCode.OK),
+            int(StatusCode.SESSION_NOT_FOUND),
+        ]
+        # Slot 0's session saw exactly the one legitimate vote.
+        assert engine.get_scope_stats("A").total_sessions == 1
+
     def test_multi_scope_unknown_scope_and_pid(self):
         engine = make_engine()
         [p] = engine.create_proposals("known", [request(n=4)], NOW)
