@@ -1277,9 +1277,11 @@ class TpuConsensusEngine(Generic[Scope]):
         return lookup
 
     def _pid_table(self, scope: Scope) -> tuple[np.ndarray, np.ndarray]:
-        """Sorted (proposal_ids, slots) arrays for one scope — the
+        """(proposal_ids, slots) membership arrays for one scope — the
         vectorized replacement for per-vote dict lookups; rebuilt lazily
-        after any membership change."""
+        after any membership change. Unordered: both consumers
+        (_pid_lookup's hash build, _draw_unique_pids' np.isin) are
+        order-independent, so the old O(P log P) sort was dead weight."""
         table = self._pid_tables.get(scope)
         if table is None:
             scope_slots = self._scopes.get(scope, [])
@@ -1292,8 +1294,7 @@ class TpuConsensusEngine(Generic[Scope]):
                 len(scope_slots),
             )
             slot_arr = np.fromiter(scope_slots, np.int64, len(scope_slots))
-            order = np.argsort(pids)
-            table = (pids[order], slot_arr[order])
+            table = (pids, slot_arr)
             self._pid_tables[scope] = table
         return table
 
@@ -1811,6 +1812,8 @@ class _PidLookup:
             return
         rem_pids = np.asarray(pids, np.int64)
         rem_slots = np.asarray(slots, np.int64)
+        if (rem_pids == -1).any():
+            raise ValueError("proposal id -1 collides with the hash sentinel")
         h = self._bucket(rem_pids)
         while rem_pids.size:
             # A bucket can be contested by several pending keys: the first
@@ -1838,9 +1841,10 @@ class _PidLookup:
         batch = len(q)
         found = np.zeros(batch, bool)
         out = np.zeros(batch, np.int64)
-        # Valid pids are u32; anything negative would otherwise match the
-        # -1 empty-bucket sentinel and "resolve" to slot 0.
-        active = np.nonzero((q >= 0) & (q <= 0xFFFFFFFF))[0]
+        # Any int64 key hashes fine (uint64 cast); only -1 must be excluded
+        # or it would match the empty-bucket sentinel and "resolve" to
+        # slot 0. (-1 is also rejected at build, so it can never be stored.)
+        active = np.nonzero(q != -1)[0]
         h = self._bucket(q[active])
         while active.size:
             k = self.keys[h]

@@ -388,6 +388,40 @@ class TestColumnarSpillIntegrity:
         }
 
 
+class TestPidLookup:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_hash_lookup_matches_dict_oracle(self, seed):
+        """Property: for random u32 pid sets, _PidLookup.lookup agrees with
+        a plain dict on hits, misses, near-misses, and sentinel values."""
+        from hashgraph_tpu.engine.engine import _PidLookup
+
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(1, 3000))
+        pids = rng.choice(2**32 - 1, size=n, replace=False).astype(np.int64)
+        slots = rng.integers(0, 10_000, size=n).astype(np.int64)
+        table = _PidLookup(pids, slots)
+        oracle = dict(zip(pids.tolist(), slots.tolist()))
+        queries = np.concatenate(
+            [
+                pids[rng.integers(0, n, size=500)],  # hits
+                rng.choice(2**32 - 1, size=500).astype(np.int64),  # mostly miss
+                np.array([-1, 0, 2**32 - 1, 2**63 - 1, -(2**62)], np.int64),
+            ]
+        )
+        found, out = table.lookup(queries)
+        for q, f, s in zip(queries.tolist(), found.tolist(), out.tolist()):
+            assert f == (q in oracle), q
+            if f:
+                assert s == oracle[q], q
+
+    def test_empty_table(self):
+        from hashgraph_tpu.engine.engine import _PidLookup
+
+        table = _PidLookup(np.empty(0, np.int64), np.empty(0, np.int64))
+        found, out = table.lookup(np.array([0, 1, -1], np.int64))
+        assert not found.any()
+
+
 class TestMultiScopeColumnar:
     def test_multi_scope_parity_with_per_scope_calls(self):
         """ingest_columnar_multi over N scopes must produce exactly the
