@@ -142,6 +142,34 @@ class TestPythonClient:
             assert cl.ping() == P.PROTOCOL_VERSION
 
 
+class TestBridgeOverShardedEngine:
+    def test_quickstart_on_device_mesh_engine(self):
+        """engine_factory wires the bridge to a sharded device-mesh engine:
+        a TCP embedder drives peers whose consensus state is sharded over
+        the full (virtual) device mesh — bridge and parallel substrate
+        composed end-to-end."""
+        from hashgraph_tpu.engine import TpuConsensusEngine
+        from hashgraph_tpu.parallel import ShardedPool, consensus_mesh
+
+        mesh = consensus_mesh()
+
+        def factory(signer):
+            return TpuConsensusEngine(
+                signer,
+                pool=ShardedPool(capacity_per_device=4, voter_capacity=8, mesh=mesh),
+            )
+
+        with BridgeServer(engine_factory=factory) as server:
+            with BridgeClient(*server.address) as client:
+                peers, pid = run_quickstart(client, "mesh")
+                for peer in peers:
+                    assert client.get_result(peer, "mesh", pid) is True
+                    events = client.poll_events(peer)
+                    assert any(
+                        e.kind == P.EVENT_REACHED and e.result for e in events
+                    )
+
+
 class TestCClient:
     def test_c_quickstart_end_to_end(self, server, tmp_path):
         """Compile the C embedder and let it run the whole scenario."""
