@@ -121,6 +121,35 @@ class BridgeClient:
             P.u32(peer) + P.string(scope) + P.u64(now) + P.blob(vote),
         )
 
+    # Soft ceiling per PROCESS_VOTES frame, comfortably under the server's
+    # 64 MiB MAX_FRAME; larger batches are chunked transparently.
+    _VOTE_FRAME_BUDGET = 8 * 1024 * 1024
+
+    def process_votes(
+        self, peer: int, scope: str, votes: list[bytes], now: int
+    ) -> list[int]:
+        """Batch delivery: one frame (chunked past ~8 MiB), per-vote
+        StatusCode list back in batch order (0 OK / 28 ALREADY_REACHED are
+        successes; 241 marks an undecodable blob; others are rejections)."""
+        statuses: list[int] = []
+        start = 0
+        while start < len(votes):
+            size = 0
+            stop = start
+            while stop < len(votes) and (
+                size + len(votes[stop]) + 4 <= self._VOTE_FRAME_BUDGET
+                or stop == start
+            ):
+                size += len(votes[stop]) + 4
+                stop += 1
+            chunk = votes[start:stop]
+            payload = [P.u32(peer), P.string(scope), P.u64(now), P.u32(len(chunk))]
+            payload.extend(P.blob(v) for v in chunk)
+            cursor = self._call(P.OP_PROCESS_VOTES, b"".join(payload))
+            statuses.extend(cursor.raw(cursor.u32()))
+            start = stop
+        return statuses
+
     def handle_timeout(self, peer: int, scope: str, pid: int, now: int) -> bool:
         cursor = self._call(
             P.OP_HANDLE_TIMEOUT, P.u32(peer) + P.string(scope) + P.u32(pid) + P.u64(now)

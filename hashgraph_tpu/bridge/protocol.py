@@ -46,6 +46,7 @@ OP_GET_RESULT = 7
 OP_POLL_EVENTS = 8
 OP_GET_PROPOSAL = 9
 OP_GET_STATS = 10
+OP_PROCESS_VOTES = 11  # batch: u32 count + count vote blobs -> u8 statuses
 
 # Bridge-level statuses (protocol StatusCode values occupy 0..29).
 STATUS_OK = 0

@@ -266,6 +266,31 @@ class BridgeServer:
         peer.engine.process_incoming_vote(scope, vote, now)
         return P.STATUS_OK, b""
 
+    def _op_process_votes(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        """Batch vote delivery: one frame, one engine dispatch, one status
+        byte per vote (StatusCode values; OK/ALREADY_REACHED are successes;
+        STATUS_BAD_REQUEST marks an undecodable blob without poisoning the
+        rest of the batch). This is the embedder's throughput path — the
+        scalar opcode costs one round trip per vote."""
+        scope = c.string()
+        now = c.u64()
+        count = c.u32()
+        statuses = [P.STATUS_BAD_REQUEST] * count
+        decodable: list[tuple[int, Vote]] = []
+        for i in range(count):
+            blob = c.blob()
+            try:
+                decodable.append((i, Vote.decode(blob)))
+            except (ValueError, IndexError):
+                pass  # per-vote 241 already set; the batch proceeds
+        if decodable:
+            engine_statuses = peer.engine.ingest_votes(
+                [(scope, vote) for _, vote in decodable], now
+            )
+            for (i, _), status in zip(decodable, engine_statuses):
+                statuses[i] = int(status) & 0xFF
+        return P.STATUS_OK, P.u32(count) + bytes(statuses)
+
     def _op_handle_timeout(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
         scope = c.string()
         pid = c.u32()
@@ -340,6 +365,7 @@ _HANDLERS = {
     P.OP_CAST_VOTE: BridgeServer._op_cast_vote,
     P.OP_PROCESS_PROPOSAL: BridgeServer._op_process_proposal,
     P.OP_PROCESS_VOTE: BridgeServer._op_process_vote,
+    P.OP_PROCESS_VOTES: BridgeServer._op_process_votes,
     P.OP_HANDLE_TIMEOUT: BridgeServer._op_handle_timeout,
     P.OP_GET_RESULT: BridgeServer._op_get_result,
     P.OP_POLL_EVENTS: BridgeServer._op_poll_events,

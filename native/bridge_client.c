@@ -39,6 +39,7 @@ enum {
   OP_POLL_EVENTS = 8,
   OP_GET_PROPOSAL = 9,
   OP_GET_STATS = 10,
+  OP_PROCESS_VOTES = 11, /* batch: u32 count + count blobs -> u8 statuses */
 };
 
 #define STATUS_OK 0
@@ -239,7 +240,11 @@ int main(int argc, char** argv) {
           "process_proposal");
   }
 
-  /* bob and carol vote YES; each vote is gossiped to the other two peers */
+  /* bob and carol vote YES. Each vote goes to the OTHER voter via the
+   * scalar opcode; alice receives BOTH in one PROCESS_VOTES batch frame
+   * (the embedder throughput path: one round trip for the whole batch). */
+  static uint8_t votes[2][4096];
+  uint32_t vlens[2];
   for (int voter = 1; voter < 3; voter++) {
     req.len = 0;
     put_u32(&req, peers[voter]);
@@ -250,20 +255,33 @@ int main(int argc, char** argv) {
     CHECK(hgb_call(fd, OP_CAST_VOTE, &req, &resp) == STATUS_OK, "cast_vote");
     cur = (hgb_cur){resp.buf, resp.len, 0};
     uint32_t vlen = get_u32(&cur);
-    static uint8_t vote[4096];
-    CHECK(vlen <= sizeof(vote) && cur.pos + vlen <= resp.len,
+    CHECK(vlen <= sizeof(votes[0]) && cur.pos + vlen <= resp.len,
           "vote length sane");
-    memcpy(vote, resp.buf + cur.pos, vlen);
-    for (int other = 0; other < 3; other++) {
-      if (other == voter) continue;
-      req.len = 0;
-      put_u32(&req, peers[other]);
-      put_str(&req, scope);
-      put_u64(&req, now + 4 + (uint64_t)voter);
-      put_blob(&req, vote, vlen);
-      CHECK(hgb_call(fd, OP_PROCESS_VOTE, &req, &resp) == STATUS_OK,
-            "process_vote");
-    }
+    memcpy(votes[voter - 1], resp.buf + cur.pos, vlen);
+    vlens[voter - 1] = vlen;
+    int other = voter == 1 ? 2 : 1;
+    req.len = 0;
+    put_u32(&req, peers[other]);
+    put_str(&req, scope);
+    put_u64(&req, now + 4 + (uint64_t)voter);
+    put_blob(&req, votes[voter - 1], vlen);
+    CHECK(hgb_call(fd, OP_PROCESS_VOTE, &req, &resp) == STATUS_OK,
+          "process_vote");
+  }
+  req.len = 0;
+  put_u32(&req, peers[0]);
+  put_str(&req, scope);
+  put_u64(&req, now + 6);
+  put_u32(&req, 2);
+  put_blob(&req, votes[0], vlens[0]);
+  put_blob(&req, votes[1], vlens[1]);
+  CHECK(hgb_call(fd, OP_PROCESS_VOTES, &req, &resp) == STATUS_OK,
+        "process_votes batch");
+  cur = (hgb_cur){resp.buf, resp.len, 0};
+  CHECK(get_u32(&cur) == 2, "batch status count");
+  for (int i = 0; i < 2; i++) {
+    uint8_t st = get_u8(&cur);
+    CHECK(st == 0 || st == 28, "batch vote accepted"); /* OK / ALREADY_REACHED */
   }
 
   /* every peer must now report YES and have emitted ConsensusReached */

@@ -117,6 +117,31 @@ class TestPythonClient:
             client.process_vote(alice, "tam", vote.encode(), NOW + 3)
         assert exc.value.status == int(StatusCode.INVALID_VOTE_HASH)
 
+    def test_batch_vote_delivery(self, client):
+        """OP_PROCESS_VOTES: one frame carries the whole vote batch; the
+        per-vote status list mirrors in-process ingest_votes (mixed
+        accept / duplicate / unknown-session codes in batch order)."""
+        alice, _ = client.add_peer()
+        bob, _ = client.add_peer()
+        pid, _ = client.create_proposal(alice, "bat", NOW, "p", b"", 4, 600)
+        proposal = client.get_proposal(alice, "bat", pid)
+        client.process_proposal(bob, "bat", proposal, NOW + 1)
+        v_bob = client.cast_vote(bob, "bat", pid, True, NOW + 2)
+        unknown = Vote.decode(v_bob)
+        unknown.proposal_id = 999_999_999
+        statuses = client.process_votes(
+            alice,
+            "bat",
+            [v_bob, v_bob, unknown.encode(), b"\xff\xff garbage"],
+            NOW + 3,
+        )
+        assert statuses == [
+            int(StatusCode.OK),
+            int(StatusCode.DUPLICATE_VOTE),
+            int(StatusCode.SESSION_NOT_FOUND),
+            P.STATUS_BAD_REQUEST,  # undecodable blob: per-vote, not fatal
+        ]
+
     def test_unknown_peer_and_session(self, client):
         with pytest.raises(BridgeError) as exc:
             client.get_result(999_999, "x", 1)
