@@ -320,16 +320,69 @@ class TpuConsensusEngine(Generic[Scope]):
         the error path is batch-atomic (any invalid request raises before
         anything registers, unlike the loop which keeps earlier items).
         """
-        existing = len(self._scopes.get(scope, []))
-        if existing + len(requests) > self._max_sessions_per_scope:
-            # Near the per-scope cap eviction interleaves with insertion;
-            # keep that path scalar (it cannot be the hot case — the cap
-            # bounds the scope's total population).
-            return [
+        return self.create_proposals_multi([(scope, requests)], now, config)[0]
+
+    def create_proposals_multi(
+        self,
+        items: "list[tuple[Scope, list[CreateProposalRequest]]]",
+        now: int,
+        config: ConsensusConfig | None = None,
+    ) -> "list[list[Proposal]]":
+        """Multi-scope batch creation (mirror of :meth:`ingest_columnar_multi`):
+        ONE device dispatch claims slots for every scope's proposals instead
+        of one dispatch per scope — the registration half of the config-5
+        churn shape. Returns one Proposal list per input item, in order.
+        Scopes must be distinct within one call (id uniqueness is checked
+        against registered sessions, which a same-call sibling batch is
+        not yet). A scope near its session cap falls back to the scalar
+        path for that scope only (reference eviction semantics interleave
+        with insertion there); fallback scopes run AFTER the batched
+        allocation, so device-slot priority deterministically favors the
+        batched population when the pool is nearly full."""
+        seen: set = set()
+        for scope, _ in items:
+            if scope in seen:
+                raise ValueError("create_proposals_multi: duplicate scope")
+            seen.add(scope)
+        out: list = [None] * len(items)
+        entries: list = []
+        spans: list = []
+        fallbacks: list = []
+        for idx, (scope, requests) in enumerate(items):
+            existing = len(self._scopes.get(scope, []))
+            if existing + len(requests) > self._max_sessions_per_scope:
+                fallbacks.append(idx)
+                spans.append(None)
+                continue
+            proposals, configs = self._prepare_creation(
+                scope, requests, now, config
+            )
+            spans.append((len(entries), len(proposals)))
+            entries.extend(
+                (scope, p, c) for p, c in zip(proposals, configs)
+            )
+        created = self._allocate_and_register(entries, now)
+        for idx, span in enumerate(spans):
+            if span is not None:
+                start, count = span
+                out[idx] = created[start : start + count]
+        for idx in fallbacks:
+            scope, requests = items[idx]
+            out[idx] = [
                 self.create_proposal(scope, r, now, config) for r in requests
             ]
-        from ..ops.decide import required_votes_np
+        return out
 
+    def _prepare_creation(
+        self,
+        scope: Scope,
+        requests: list[CreateProposalRequest],
+        now: int,
+        config: ConsensusConfig | None,
+    ) -> tuple[list[Proposal], list[ConsensusConfig]]:
+        """Python-side prep shared by the batch creators: mint proposals
+        with batch-drawn ids (single-host) or deterministic ids (multi-host)
+        and resolve configs with per-batch memoization."""
         proposals: list[Proposal] = []
         configs: list[ConsensusConfig] = []
         # Single-host fast path: draw the whole batch's proposal ids in one
@@ -363,10 +416,21 @@ class TpuConsensusEngine(Generic[Scope]):
                 resolved = self._resolve_config(scope, config, proposal)
                 cfg_cache[key] = resolved
             configs.append(resolved)
+        return proposals, configs
+
+    def _allocate_and_register(
+        self,
+        entries: "list[tuple[Scope, Proposal, ConsensusConfig]]",
+        now: int,
+    ) -> list[Proposal]:
+        """One pool.allocate_batch for every (scope, proposal, config) entry
+        (first-fit against the free budget; the rest host-spill), then host
+        registration. Returns clones in entry order."""
+        from ..ops.decide import required_votes_np
 
         free = self._pool.free_slots
         fit_idx: list[int] = []
-        for i, proposal in enumerate(proposals):
+        for i, (_, proposal, _) in enumerate(entries):
             if (
                 proposal.expected_voters_count <= self._pool.voter_capacity
                 and len(fit_idx) < free
@@ -376,20 +440,22 @@ class TpuConsensusEngine(Generic[Scope]):
         if fit_idx:
             count = len(fit_idx)
             n_arr = np.fromiter(
-                (proposals[i].expected_voters_count for i in fit_idx),
+                (entries[i][1].expected_voters_count for i in fit_idx),
                 np.int64,
                 count,
             )
             thr_arr = np.fromiter(
-                (configs[i].consensus_threshold for i in fit_idx),
+                (entries[i][2].consensus_threshold for i in fit_idx),
                 np.float64,
                 count,
             )
             gossip_arr = np.fromiter(
-                (configs[i].use_gossipsub_rounds for i in fit_idx), bool, count
+                (entries[i][2].use_gossipsub_rounds for i in fit_idx),
+                bool,
+                count,
             )
             maxr_arr = np.fromiter(
-                (configs[i].max_rounds for i in fit_idx), np.int64, count
+                (entries[i][2].max_rounds for i in fit_idx), np.int64, count
             )
             req_arr = required_votes_np(n_arr, thr_arr)
             # max_round_limit semantics (reference: src/session.rs:120-128):
@@ -404,38 +470,38 @@ class TpuConsensusEngine(Generic[Scope]):
             )
             slots = self._pool.allocate_batch(
                 keys=[
-                    (scope, proposals[i].proposal_id) for i in fit_idx
+                    (entries[i][0], entries[i][1].proposal_id) for i in fit_idx
                 ],
                 n=n_arr,
                 req=req_arr,
                 cap=cap_arr,
                 gossip=gossip_arr,
                 liveness=np.fromiter(
-                    (proposals[i].liveness_criteria_yes for i in fit_idx),
+                    (entries[i][1].liveness_criteria_yes for i in fit_idx),
                     bool,
-                    len(fit_idx),
+                    count,
                 ),
                 expiry=np.fromiter(
-                    (proposals[i].expiration_timestamp for i in fit_idx),
+                    (entries[i][1].expiration_timestamp for i in fit_idx),
                     np.int64,
-                    len(fit_idx),
+                    count,
                 ),
-                created_at=np.full(len(fit_idx), now, np.int64),
+                created_at=np.full(count, now, np.int64),
             )
             slots_by_item = dict(zip(fit_idx, slots))
 
-        scope_slots = self._scopes.setdefault(scope, [])
-        for i, proposal in enumerate(proposals):
+        touched: set = set()
+        for i, (scope, proposal, cfg) in enumerate(entries):
             slot = slots_by_item.get(i)
             if slot is None:  # host spill (oversized n or pool exhausted)
-                host_session = ConsensusSession._new(proposal, configs[i], now)
+                host_session = ConsensusSession._new(proposal, cfg, now)
                 slot = self._next_host_slot
                 self._next_host_slot -= 1
                 record = SessionRecord(
                     scope=scope,
                     slot=slot,
                     proposal=proposal,
-                    config=configs[i],
+                    config=cfg,
                     created_at=now,
                     session=host_session,
                 )
@@ -446,15 +512,17 @@ class TpuConsensusEngine(Generic[Scope]):
                     scope=scope,
                     slot=slot,
                     proposal=proposal,
-                    config=configs[i],
+                    config=cfg,
                     created_at=now,
                 )
             self._records[slot] = record
             self._index[(scope, proposal.proposal_id)] = slot
-            scope_slots.append(slot)
-        self._pid_tables.pop(scope, None)
-        self._pid_hashes.pop(scope, None)
-        return [p.clone() for p in proposals]
+            self._scopes.setdefault(scope, []).append(slot)
+            touched.add(scope)
+        for scope in touched:
+            self._pid_tables.pop(scope, None)
+            self._pid_hashes.pop(scope, None)
+        return [p.clone() for _, p, _ in entries]
 
     def process_incoming_proposal(
         self, scope: Scope, proposal: Proposal, now: int
@@ -1875,6 +1943,7 @@ def _synchronized(fn):
 for _name in (
     "create_proposal",
     "create_proposals",
+    "create_proposals_multi",
     "process_incoming_proposal",
     "ingest_proposals",
     "ingest_columnar",

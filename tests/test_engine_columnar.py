@@ -525,6 +525,33 @@ class TestMultiScopeColumnar:
         # Slot 0's session saw exactly the one legitimate vote.
         assert engine.get_scope_stats("A").total_sessions == 1
 
+    def test_create_proposals_multi_matches_per_scope_loop(self):
+        """One cross-scope allocate must register exactly what per-scope
+        create_proposals calls would: same counts, same per-scope stats,
+        same spill behavior when the pool runs out, and a rejected
+        duplicate scope."""
+        eng = make_engine(capacity=16)
+        scopes = ["m0", "m1", "m2"]
+        batches = eng.create_proposals_multi(
+            [(s, [request(n=4) for _ in range(6)]) for s in scopes], NOW
+        )
+        assert [len(b) for b in batches] == [6, 6, 6]
+        for scope, batch in zip(scopes, batches):
+            stats = eng.get_scope_stats(scope)
+            assert stats.total_sessions == 6 and stats.active_sessions == 6
+            for p in batch:
+                assert eng.get_consensus_result(scope, p.proposal_id) is None
+        # 18 sessions > 16 slots: exactly 2 spilled to the host substrate.
+        assert eng.pool().free_slots == 0
+        spilled = sum(
+            1 for r in eng._records.values() if r.session is not None
+        )
+        assert spilled == 2
+        with pytest.raises(ValueError):
+            eng.create_proposals_multi(
+                [("dup", [request()]), ("dup", [request()])], NOW
+            )
+
     def test_multi_scope_unknown_scope_and_pid(self):
         engine = make_engine()
         [p] = engine.create_proposals("known", [request(n=4)], NOW)
