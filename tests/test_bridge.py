@@ -167,6 +167,58 @@ class TestPythonClient:
             assert cl.ping() == P.PROTOCOL_VERSION
 
 
+class TestConcurrentClients:
+    def test_parallel_connections_share_peers_safely(self, server):
+        """Many connections driving the same peer concurrently: the engine's
+        lock must serialize mutations so exactly the expected vote set lands
+        (reference concurrency contract, tests/concurrency_tests.rs)."""
+        import threading
+
+        host, port = server.address
+        with BridgeClient(host, port) as setup:
+            alice, _ = setup.add_peer()
+            pid, _ = setup.create_proposal(alice, "cc", NOW, "p", b"", 32, 600)
+            proposal = setup.get_proposal(alice, "cc", pid)
+            # 8 remote voters, one engine-backed peer each, pre-built votes.
+            voters = [setup.add_peer()[0] for _ in range(8)]
+            votes = []
+            for voter in voters:
+                setup.process_proposal(voter, "cc", proposal, NOW + 1)
+                votes.append(setup.cast_vote(voter, "cc", pid, True, NOW + 2))
+
+        statuses: dict[int, list[int]] = {}
+        errors: list[Exception] = []
+
+        def deliver(i: int, vote: bytes) -> None:
+            try:
+                with BridgeClient(host, port) as cl:
+                    # Each thread its own connection; two deliveries per
+                    # vote so duplicates race against first-writers.
+                    statuses[i] = cl.process_votes(
+                        alice, "cc", [vote, vote], NOW + 3
+                    )
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=deliver, args=(i, v))
+            for i, v in enumerate(votes)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors
+        flat = [s for pair in statuses.values() for s in pair]
+        # Exactly one success per voter; the duplicate copy is rejected
+        # (or arrives after decision as ALREADY_REACHED).
+        ok = flat.count(int(StatusCode.OK)) + flat.count(28)
+        dup = flat.count(int(StatusCode.DUPLICATE_VOTE))
+        assert ok == 8 and dup == 8, flat
+        with BridgeClient(host, port) as check:
+            assert check.get_stats(alice, "cc") == (1, 1, 0, 0)
+
+
 class TestBridgeOverShardedEngine:
     def test_quickstart_on_device_mesh_engine(self):
         """engine_factory wires the bridge to a sharded device-mesh engine:
