@@ -98,13 +98,23 @@ class SessionRecord(Generic[Scope]):
     votes: dict[bytes, Vote] = field(default_factory=dict)  # accepted only
     session: ConsensusSession | None = None  # host fallback substrate
     # Opt-in columnar retention: verbatim wire bytes of accepted votes as
-    # (packed blob, local offsets) chunks in arrival order. Decoded lazily
-    # on proposal export so a columnar-ingested session can be re-gossiped
+    # (arrival seq, packed blob, local offsets) chunks. Decoded lazily on
+    # proposal export so a columnar-ingested session can be re-gossiped
     # with a chain-valid vote list; empty unless the caller passed
     # wire_votes to ingest_columnar. ``retained_cache`` memoizes the decode
     # (chunk-count keyed: retained_wire only grows by append).
-    retained_wire: list[tuple[bytes, np.ndarray]] = field(default_factory=list)
-    retained_cache: tuple[int, list[Vote]] | None = None
+    retained_wire: list[tuple[int, bytes, np.ndarray]] = field(default_factory=list)
+    retained_cache: tuple[int, list[tuple[int, list[Vote]]]] | None = None
+    # Per-record arrival clock: scalar accepted votes take one tick each,
+    # every retained columnar chunk takes one tick, so exports can merge
+    # the two paths back into true (call-granularity) arrival order.
+    arrival_seq: int = 0
+    scalar_seqs: list[int] = field(default_factory=list)
+
+    def next_arrival_seq(self) -> int:
+        seq = self.arrival_seq
+        self.arrival_seq += 1
+        return seq
 
     def bump_round(self, accepted: int) -> None:
         """Host mirror of the device round update
@@ -923,6 +933,7 @@ class TpuConsensusEngine(Generic[Scope]):
                 stored = vote.clone()  # as the scalar add_vote does
                 record.votes[stored.vote_owner] = stored
                 record.proposal.votes.append(stored)
+                record.scalar_seqs.append(record.next_arrival_seq())
                 record.bump_round(1)
                 last_ok[int(slots[j])] = j
 
@@ -1085,7 +1096,10 @@ class TpuConsensusEngine(Generic[Scope]):
             lo, hi = int(seg_bounds[k]), int(seg_bounds[k + 1])
             seg_off = (out_off[lo : hi + 1] - out_off[lo]).copy()
             seg_blob = blob[int(out_off[lo]) : int(out_off[hi])].tobytes()
-            self._records[int(slot)].retained_wire.append((seg_blob, seg_off))
+            record = self._records[int(slot)]
+            record.retained_wire.append(
+                (record.next_arrival_seq(), seg_blob, seg_off)
+            )
 
     def ingest_columnar_multi(
         self,
@@ -1375,7 +1389,11 @@ class TpuConsensusEngine(Generic[Scope]):
         Returns (status code, event-to-emit-or-None); the caller queues the
         event so emission order follows per-vote arrival order even when a
         batch mixes substrates."""
-        return self._host_apply(record, lambda s: s.add_vote(vote, now), now)
+        code, event = self._host_apply(record, lambda s: s.add_vote(vote, now), now)
+        if code == int(StatusCode.OK):
+            # add_vote appended to the shared proposal's vote list.
+            record.scalar_seqs.append(record.next_arrival_seq())
+        return code, event
 
     def _host_add_tally(
         self, record: SessionRecord[Scope], owner: bytes, value: bool, now: int
@@ -1531,30 +1549,55 @@ class TpuConsensusEngine(Generic[Scope]):
 
     # ── Queries (reference: src/storage.rs:112-180 derived helpers) ────
 
-    def _decoded_retained(self, record: SessionRecord[Scope]) -> list[Vote]:
-        """Decode a record's retained wire bytes once per growth; exports
-        clone the cached Vote objects so callers can't mutate the cache."""
+    def _decoded_retained(
+        self, record: SessionRecord[Scope]
+    ) -> list[tuple[int, list[Vote]]]:
+        """Decode a record's retained wire bytes once per growth, keeping
+        each chunk's arrival seq; exports clone the cached Vote objects so
+        callers can't mutate the cache."""
         n = len(record.retained_wire)
         if n == 0:
             return []
         if record.retained_cache is None or record.retained_cache[0] != n:
-            votes: list[Vote] = []
-            for data, offs in record.retained_wire:
-                votes.extend(
-                    Vote.decode(data[offs[k] : offs[k + 1]])
-                    for k in range(len(offs) - 1)
+            chunks: list[tuple[int, list[Vote]]] = []
+            for seq, data, offs in record.retained_wire:
+                chunks.append(
+                    (
+                        seq,
+                        [
+                            Vote.decode(data[offs[k] : offs[k + 1]])
+                            for k in range(len(offs) - 1)
+                        ],
+                    )
                 )
-            record.retained_cache = (n, votes)
+            record.retained_cache = (n, chunks)
         return record.retained_cache[1]
 
     def _materialized_proposal(self, record: SessionRecord[Scope]) -> Proposal:
         """Export view of a record's proposal: retained columnar wire bytes
-        (if any) are decoded and re-embedded after the scalar-ingested votes,
-        in arrival order, so the result chain-validates at a receiving peer."""
+        (if any) are decoded and merged with the scalar-ingested votes in
+        TRUE arrival order (per-record seq: one tick per scalar accept, one
+        per retained chunk), so a session fed through both paths still
+        re-gossips a chain-valid vote list."""
         proposal = record.proposal.clone()
         retained = self._decoded_retained(record)
         if retained:
-            proposal.votes = list(proposal.votes) + [v.clone() for v in retained]
+            scalar = proposal.votes
+            # Votes embedded at registration predate the arrival clock and
+            # keep their leading position (seq -1, stable sort).
+            n_pre = len(scalar) - len(record.scalar_seqs)
+            items: list[tuple[int, list[Vote]]] = [
+                (-1, scalar[:n_pre])
+            ] if n_pre else []
+            items.extend(
+                (seq, [vote])
+                for seq, vote in zip(record.scalar_seqs, scalar[n_pre:])
+            )
+            items.extend(
+                (seq, [v.clone() for v in votes]) for seq, votes in retained
+            )
+            items.sort(key=lambda t: t[0])
+            proposal.votes = [v for _, votes in items for v in votes]
         return proposal
 
     def get_proposal(self, scope: Scope, proposal_id: int) -> Proposal:
@@ -1613,15 +1656,20 @@ class TpuConsensusEngine(Generic[Scope]):
         were retained export as real signed votes instead of tallies, so the
         re-gossip capability survives a save/load round-trip."""
         record = self._get_record(scope, proposal_id)
-        retained = self._decoded_retained(record)
+        retained_votes = [
+            vote for _, votes in self._decoded_retained(record) for vote in votes
+        ]
         if record.session is not None:
             session = record.session.clone()
-            for vote in retained:
-                # A retained signed vote supersedes its tally entry.
-                session.tallies.pop(vote.vote_owner, None)
-                if vote.vote_owner not in session.votes:
-                    session.votes[vote.vote_owner] = vote.clone()
-                    session.proposal.votes.append(vote.clone())
+            if retained_votes:
+                # The materialized proposal merges both paths' votes in
+                # arrival order; the dict/tally bookkeeping follows.
+                session.proposal = self._materialized_proposal(record)
+                for vote in retained_votes:
+                    # A retained signed vote supersedes its tally entry.
+                    session.tallies.pop(vote.vote_owner, None)
+                    if vote.vote_owner not in session.votes:
+                        session.votes[vote.vote_owner] = vote.clone()
             return session
         votes = {k: v.clone() for k, v in record.votes.items()}
         tallies: dict[bytes, bool] = {}
@@ -1632,7 +1680,7 @@ class TpuConsensusEngine(Generic[Scope]):
             if owner is None or owner in votes:
                 continue  # scalar votes already carry this participant
             tallies[owner] = bool(row["vote_val"][lane])
-        for vote in retained:
+        for vote in retained_votes:
             tallies.pop(vote.vote_owner, None)
             votes.setdefault(vote.vote_owner, vote.clone())
         return ConsensusSession(

@@ -671,6 +671,50 @@ class TestWireRetention:
 
         validate_vote_chain(exported.votes)
 
+    def test_mixed_scalar_and_columnar_exports_true_arrival_order(self):
+        """A session fed through BOTH paths — scalar vote, columnar chunk,
+        scalar vote, columnar chunk — must export its votes in true arrival
+        order (not path-concatenated), chain-valid at a peer."""
+        from hashgraph_tpu import Proposal
+        from hashgraph_tpu.protocol import validate_vote_chain
+
+        engine = make_engine()
+        peer = make_engine()
+        # n=8, liveness NO: 5 YES of 8 never decides mid-stream (req 6).
+        proposal = engine.create_proposal("s", request(n=8, liveness=False), NOW)
+        signers = [random_stub_signer() for _ in range(5)]
+        votes = self._chained_votes(proposal, signers, NOW + 1)
+
+        def columnar(vs):
+            gids = np.array([engine.voter_gid(v.vote_owner) for v in vs])
+            st = engine.ingest_columnar(
+                "s",
+                np.full(len(vs), proposal.proposal_id, np.int64),
+                gids,
+                np.array([v.vote for v in vs]),
+                NOW + 10,
+                wire_votes=[v.encode() for v in vs],
+            )
+            assert (st == int(StatusCode.OK)).all(), st
+
+        # arrival: scalar v0 | columnar [v1, v2] | scalar v3 | columnar [v4]
+        engine.process_incoming_vote("s", votes[0], NOW + 9)
+        columnar(votes[1:3])
+        engine.process_incoming_vote("s", votes[3], NOW + 9)
+        columnar(votes[4:5])
+
+        exported = engine.get_proposal("s", proposal.proposal_id)
+        assert [v.vote_owner for v in exported.votes] == [
+            v.vote_owner for v in votes
+        ]
+        validate_vote_chain(exported.votes)
+        peer.process_incoming_proposal(
+            "s", Proposal.decode(exported.encode()), NOW + 11
+        )
+        assert (
+            peer.get_scope_stats("s").total_sessions == 1
+        )  # full gauntlet passed
+
     def test_no_retention_without_opt_in(self):
         engine = make_engine()
         proposal = engine.create_proposal("s", request(n=3), NOW)
