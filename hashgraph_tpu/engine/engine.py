@@ -1011,11 +1011,11 @@ class TpuConsensusEngine(Generic[Scope]):
           so gossip reconstruction/export sees tallies but not vote chains;
           pass ``wire_votes`` (the encoded Vote bytes per row, either a list
           or a ``(packed, offsets)`` pair) to retain accepted rows' verbatim
-          bytes off the timing path — proposal exports then re-embed them in
+          bytes off the timing path — proposal exports then re-embed them,
+          merged with any scalar-ingested votes in true (call-granularity)
           arrival order, so the proposal re-gossips with a chain-valid vote
-          list (reference: src/utils.rs:175-215). Retention assumes the
-          session is fed columnar-only (mixing scalar and columnar ingest on
-          one session interleaves the two vote lists by path, not arrival);
+          list even for sessions fed through both paths (reference:
+          src/utils.rs:175-215);
         - event ordering is guaranteed per-session, not across sessions.
 
         Resolution is fully vectorized (open-addressing _PidLookup hash for
