@@ -69,6 +69,27 @@ Scope = TypeVar("Scope", bound=Hashable)
 
 _U32_MAX = 0xFFFFFFFF
 
+
+def _canonical_scope_bytes(scope) -> bytes:
+    """Process-independent byte encoding of a scope for the multi-host
+    deterministic pid derivation. repr() is NOT safe here: the default
+    object repr embeds a memory address, which would silently de-sync the
+    replicated control plane — the exact failure deterministic pids exist
+    to prevent — so non-canonical scope types are a hard error in
+    multi-host mode."""
+    if isinstance(scope, str):
+        return b"s:" + scope.encode()
+    if isinstance(scope, (bytes, bytearray)):
+        return b"b:" + bytes(scope)
+    if isinstance(scope, int):
+        # int(scope) so bool encodes identically to the int it equals
+        # (True and 1 are the same dict key, so they are the same scope).
+        return b"i:" + str(int(scope)).encode()
+    raise TypeError(
+        f"multi-host mode requires str/bytes/int scopes (canonical "
+        f"cross-process encoding); got {type(scope).__name__}"
+    )
+
 _STATE_TO_SCALAR = {
     STATE_ACTIVE: ConsensusState.active(),
     STATE_FAILED: ConsensusState.failed(),
@@ -257,7 +278,7 @@ class TpuConsensusEngine(Generic[Scope]):
                 digest = hashlib.sha256(
                     b"|".join(
                         [
-                            repr(scope).encode(),
+                            _canonical_scope_bytes(scope),
                             proposal.name.encode(),
                             proposal.payload,
                             proposal.proposal_owner,
@@ -298,7 +319,10 @@ class TpuConsensusEngine(Generic[Scope]):
             np.int64
         )
         for _ in range(64):
-            bad = np.isin(ids, existing)
+            # 0 is treated as a collision: the multi-host deterministic path
+            # rejects it and proto3 drops zero fields from the wire encoding
+            # — both creation paths must mint from the same id space.
+            bad = np.isin(ids, existing) | (ids == 0)
             _, first_idx, inverse, counts = np.unique(
                 ids, return_index=True, return_inverse=True, return_counts=True
             )
@@ -980,13 +1004,14 @@ class TpuConsensusEngine(Generic[Scope]):
     def voter_gid(self, owner: bytes) -> int:
         """Intern an owner identity for the columnar ingest path.
 
-        LIFETIME CONTRACT: a gid is stable only while its owner has live
-        sessions referencing it. Any call that can release sessions
-        (delete_scope, per-scope-cap eviction inside create_proposal, spill)
-        may free the id, after which it is rejected (typed status) until the
-        id is recycled by a later intern — a stale gid used after recycling
-        is attributed to the new claimant. Re-intern per batch (a dict hit)
-        rather than holding gids across calls that mutate membership."""
+        Gids are generation-tagged (``generation << 32 | index``): a gid
+        freed by any session-releasing call (delete_scope, per-scope-cap
+        eviction inside create_proposal, spill) is rejected with
+        EMPTY_VOTE_OWNER from then on — including after its index is
+        recycled to a new owner, whose gid carries a newer generation.
+        Stale use is therefore always a typed error, never silent
+        misattribution; re-interning per batch (a dict hit) merely avoids
+        the rejections for voters whose membership churns."""
         return self._pool.voter_gid(owner)
 
     def ingest_columnar(
@@ -1059,6 +1084,12 @@ class TpuConsensusEngine(Generic[Scope]):
             raise ValueError("wire_votes must supply one entry per batch row")
         if len(offsets) and int(offsets[-1]) > len(data_arr):
             raise ValueError("wire_votes offsets exceed the packed data")
+        if len(offsets) and (
+            int(offsets[0]) < 0 or (np.diff(offsets) < 0).any()
+        ):
+            raise ValueError(
+                "wire_votes offsets must be non-negative and non-decreasing"
+            )
         return data_arr, offsets
 
     def _retain_wire(
@@ -1187,13 +1218,12 @@ class TpuConsensusEngine(Generic[Scope]):
         bookkeeping, and event emission."""
         from .pool import group_batch
 
-        # Gids must be LIVE interned identities (voter_gid): out-of-range and
-        # freed-but-unclaimed ids get a typed per-row status on BOTH
-        # substrates — previously the spill path raised IndexError mid-batch
-        # while the device path silently accepted any integer as a fresh
-        # voter. NOTE: a stale gid used after its id has been recycled by a
-        # NEW intern is indistinguishable from the new owner — that misuse
-        # is excluded by voter_gid's lifetime contract (re-intern per batch).
+        # Gids must be LIVE current-generation identities (voter_gid):
+        # out-of-range, freed, and stale-generation ids (held across a
+        # release, even after the index was recycled to a new owner) all get
+        # a typed per-row status on BOTH substrates — previously the spill
+        # path raised IndexError mid-batch while the device path silently
+        # accepted any integer as a fresh voter.
         if self._multihost:
             # Misrouted rows (device slots another process owns) report the
             # session as not found on this host; the relay routes by
@@ -1238,7 +1268,11 @@ class TpuConsensusEngine(Generic[Scope]):
         dslots = slots[dev_rows]
         lanes = np.empty(0, np.int32)
         if dev_rows.size:
-            lanes = self._pool.lanes_for_batch(dslots, voter_gids[dev_rows])
+            # assume_live: this batch already passed the gids_live gate
+            # above — skip the pool's duplicate O(B) liveness pass.
+            lanes = self._pool.lanes_for_batch(
+                dslots, voter_gids[dev_rows], assume_live=True
+            )
             no_lane = lanes < 0
             if no_lane.any():
                 statuses[dev_rows[no_lane]] = int(

@@ -228,10 +228,18 @@ class ProposalPool:
         # interned-but-never-voted ids, reclaimable via
         # clear_voter_registry at a quiesce point). numpy arrays (geometric
         # growth) keep the refcount bumps vectorized on the columnar path;
-        # _gid_live distinguishes mapped ids from freed ones so stale gids
-        # are rejected rather than misattributed.
+        # _gid_live distinguishes mapped ids from freed ones, and _gid_gen
+        # counts how many times each index has been evicted: the public gid
+        # is ``generation << 32 | index``, so a stale gid held across a
+        # release AND a recycling re-intern never equals the new claimant's
+        # gid — stale use is a typed rejection, not silent misattribution.
         self._gid_refs = np.zeros(0, np.int64)
         self._gid_live = np.zeros(0, bool)
+        self._gid_gen = np.zeros(0, np.int64)
+        # Generations start at this floor; clear_voter_registry raises it
+        # past every generation ever minted so pre-clear gids can never
+        # equal a post-clear claimant's gid.
+        self._gen_floor = 0
         self._free_gids: list[int] = []
         self._lane_gids = np.full((capacity, voter_capacity), -1, np.int32)
         self._lane_count = np.zeros(capacity, np.int32)
@@ -269,13 +277,15 @@ class ProposalPool:
     # ── Voter identity / lane resolution ───────────────────────────────
 
     def voter_gid(self, owner: bytes) -> int:
-        """Intern owner bytes to a stable global voter id (first use
-        assigns; ids of fully-released voters are recycled). Columnar
-        callers ship these ids instead of bytes. A gid stays valid while
-        any live slot references it or until the next intern after its last
-        reference is released — callers must not hold gids across release
-        boundaries (engine calls are serialized under one lock, so a
-        batch's gids are stable for the duration of that batch)."""
+        """Intern owner bytes to a generation-tagged global voter id
+        (``generation << 32 | index``; first use assigns, indices of
+        fully-released voters are recycled under a bumped generation).
+        Columnar callers ship these ids instead of bytes. A gid freed by a
+        release is rejected with a typed status from then on — including
+        after its index is recycled to a new owner, whose gid carries a
+        different generation. Holding a gid across membership-mutating
+        calls is therefore safe-but-wasteful (it may start rejecting);
+        re-intern per batch (a dict hit) for gids that track membership."""
         gid = self._gid_of.get(owner)
         if gid is None:
             if self._free_gids:
@@ -293,18 +303,23 @@ class ProposalPool:
                     self._gid_live = np.concatenate(
                         [self._gid_live, np.zeros(grow, bool)]
                     )
+                    self._gid_gen = np.concatenate(
+                        [self._gid_gen, np.full(grow, self._gen_floor, np.int64)]
+                    )
                 self._gid_refs[gid] = 0
             self._gid_live[gid] = True
             self._gid_of[owner] = gid
-        return gid
+        return (int(self._gid_gen[gid]) << 32) | gid
 
     def owner_of_gid(self, gid: int) -> bytes:
-        return self._owners[gid]
+        """Owner bytes for a gid the caller has checked via gids_live
+        (the generation tag is stripped; liveness is not re-checked)."""
+        return self._owners[int(gid) & 0xFFFFFFFF]
 
     @property
     def voter_gid_count(self) -> int:
-        """Size of the gid id-space; valid gids are [0, voter_gid_count).
-        Recycled ids keep this from growing with voter churn."""
+        """Size of the gid index-space (low 32 bits of public gids).
+        Recycled indices keep this from growing with voter churn."""
         return len(self._owners)
 
     @property
@@ -323,15 +338,20 @@ class ProposalPool:
         return out
 
     def gids_live(self, gids: np.ndarray) -> np.ndarray:
-        """Bool mask: True where the gid currently maps an interned owner.
-        Out-of-range ids and freed (recycled-but-unclaimed) ids are False —
-        columnar callers use this to reject stale gids instead of silently
-        attributing votes to whichever owner later claims the recycled id."""
+        """Bool mask: True where the gid currently maps an interned owner
+        AND carries that index's current generation. Out-of-range ids,
+        freed ids, and stale-generation ids (held across a release, even
+        after the index was recycled to a new owner) are all False —
+        columnar callers use this to reject stale gids with a typed status
+        instead of attributing votes to the recycled index's new claimant."""
         gids = np.asarray(gids, np.int64)
+        idx = gids & 0xFFFFFFFF
+        gen = gids >> 32
         out = np.zeros(len(gids), bool)
-        ok = (gids >= 0) & (gids < len(self._owners))
+        ok = (gids >= 0) & (idx < len(self._owners))
         if ok.any():
-            out[ok] = self._gid_live[gids[ok]]
+            sel = idx[ok]
+            out[ok] = self._gid_live[sel] & (self._gid_gen[sel] == gen[ok])
         return out
 
     def clear_voter_registry(self) -> None:
@@ -349,10 +369,16 @@ class ProposalPool:
                 f"cannot clear voter registry with {len(self._meta)} slots "
                 "allocated (their lane tables reference interned gids)"
             )
+        # Raise the generation floor past everything ever minted: a gid
+        # held across the clear must keep rejecting (typed), not become
+        # bit-identical to the first post-clear claimant's gid.
+        if len(self._gid_gen):
+            self._gen_floor = int(self._gid_gen.max()) + 1
         self._gid_of.clear()
         self._owners.clear()
         self._gid_refs = np.zeros(0, np.int64)
         self._gid_live = np.zeros(0, bool)
+        self._gid_gen = np.zeros(0, np.int64)
         self._free_gids.clear()
 
     def lane_for(self, slot: int, owner: bytes) -> int | None:
@@ -361,20 +387,22 @@ class ProposalPool:
         protocol bounds distinct voters by expected_voters_count ≤ V in P2P
         mode; Gossipsub mode accepts arbitrarily many distinct voters, so
         size ``voter_capacity`` accordingly."""
-        gid = self.voter_gid(owner)
+        idx = self.voter_gid(owner) & 0xFFFFFFFF  # lane tables store indices
         row = self._lane_gids[slot]
-        hits = np.nonzero(row == gid)[0]
+        hits = np.nonzero(row == idx)[0]
         if hits.size:
             return int(hits[0])
         count = int(self._lane_count[slot])
         if count >= self.voter_capacity:
             return None
-        row[count] = gid
+        row[count] = idx
         self._lane_count[slot] = count + 1
-        self._gid_refs[gid] += 1
+        self._gid_refs[idx] += 1
         return count
 
-    def lanes_for_batch(self, slots: np.ndarray, gids: np.ndarray) -> np.ndarray:
+    def lanes_for_batch(
+        self, slots: np.ndarray, gids: np.ndarray, assume_live: bool = False
+    ) -> np.ndarray:
         """Vectorized lane_for over a flat arrival-ordered batch.
 
         Existing assignments resolve by a dense [B, V] match; unseen
@@ -382,12 +410,41 @@ class ProposalPool:
         order. Returns int32 lanes with -1 marking voter-capacity
         exhaustion. Cost is O(B·V) int32 host work — the per-vote Python
         dictionary hop this replaces is ~50x slower per vote.
+
+        ``assume_live=True`` skips the liveness/generation gate for callers
+        that already filtered the batch through :meth:`gids_live` (the
+        engine's columnar path — avoids a duplicate O(B) pass).
         """
         slots = np.asarray(slots, np.int64)
-        gids32 = np.asarray(gids, np.int32)
+        gids_i64 = np.asarray(gids, np.int64)
+        idx64 = gids_i64 & 0xFFFFFFFF
+        gids32 = idx64.astype(np.int32)
         lanes = np.full(len(slots), -1, np.int32)
         if len(slots) == 0:
             return lanes
+        # In-range ids are real registry indices: require live + current
+        # generation, else refuse the lane (-1). A freed or stale-generation
+        # gid must never claim a lane — it would be stored in _lane_gids and
+        # then wrongly decrement the recycled index's refcount on slot
+        # release, evicting a live voter. Out-of-range ids are synthetic
+        # (direct pool callers) and pass through unrefcounted as before.
+        if not assume_live:
+            in_range = (gids_i64 >= 0) & (idx64 < len(self._owners))
+            if in_range.any():
+                ir = np.nonzero(in_range)[0]
+                sel = idx64[ir]
+                bad = ~(
+                    self._gid_live[sel]
+                    & (self._gid_gen[sel] == (gids_i64[ir] >> 32))
+                )
+                if bad.any():
+                    keep = np.ones(len(slots), bool)
+                    keep[ir[bad]] = False
+                    ok_rows = np.nonzero(keep)[0]
+                    lanes[ok_rows] = self.lanes_for_batch(
+                        slots[ok_rows], gids_i64[ok_rows], assume_live=True
+                    )
+                    return lanes
         # The dense [B, V] match is only needed for votes whose slot already
         # has assignments — on fresh slots (the common streaming case) the
         # whole batch short-circuits to first-occurrence assignment.
@@ -428,13 +485,12 @@ class ProposalPool:
         ).astype(np.int32)
         assigned = ugid[valid].astype(np.int64)
         if assigned.size:
-            # Only LIVE interned gids participate in refcounted eviction;
-            # synthetic ids from direct pool callers — including in-range
-            # freed ids — pass through unrefcounted (and are never evicted),
-            # so they cannot desync a recycled id's count.
-            in_range = (assigned >= 0) & (assigned < len(self._owners))
-            sel = assigned[in_range]
-            sel = sel[self._gid_live[sel]]
+            # In-range ids reaching here are live current-generation indices
+            # (stale and freed ids were refused above), so every stored
+            # in-range reference is counted and _retire_lanes' decrement is
+            # exact; synthetic out-of-range ids pass through unrefcounted
+            # (and are never evicted).
+            sel = assigned[(assigned >= 0) & (assigned < len(self._owners))]
             np.add.at(self._gid_refs, sel, 1)
         lanes[rem] = np.where(valid, lane_uniq, -1)[inverse].astype(np.int32)
         return lanes
@@ -565,6 +621,11 @@ class ProposalPool:
             del self._gid_of[self._owners[gid]]
             self._owners[gid] = b""
             self._gid_live[gid] = False
+            # Bump the generation so every gid minted for this index before
+            # the eviction is permanently distinguishable from the next
+            # claimant's gid (stale use → typed rejection, never
+            # misattribution).
+            self._gid_gen[gid] += 1
             self._free_gids.append(gid)
 
     # ── Hot paths ──────────────────────────────────────────────────────

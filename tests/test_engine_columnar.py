@@ -671,6 +671,40 @@ class TestWireRetention:
 
         validate_vote_chain(exported.votes)
 
+    def test_malformed_offsets_fail_before_any_state_mutates(self):
+        """A (packed, offsets) pair with negative or non-monotone offsets
+        must fail the whole call up front — not apply votes and then strand
+        them without retained bytes (or retain garbage slices)."""
+        import pytest
+
+        engine = make_engine()
+        proposal = engine.create_proposal("s", request(n=4), NOW)
+        signers = [random_stub_signer() for _ in range(2)]
+        votes = self._chained_votes(proposal, signers, NOW + 1)
+        packed = b"".join(v.encode() for v in votes)
+        bad_offsets = np.array(
+            [len(votes[0].encode()), 0, len(packed)], np.int64
+        )  # decreasing
+        with pytest.raises(ValueError, match="non-decreasing"):
+            engine.ingest_columnar(
+                "s",
+                np.full(len(votes), proposal.proposal_id, np.int64),
+                np.array([engine.voter_gid(v.vote_owner) for v in votes]),
+                np.array([v.vote for v in votes]),
+                NOW + 10,
+                wire_votes=(packed, bad_offsets),
+            )
+        # Nothing was applied: the same rows are still ingestable.
+        statuses = engine.ingest_columnar(
+            "s",
+            np.full(len(votes), proposal.proposal_id, np.int64),
+            np.array([engine.voter_gid(v.vote_owner) for v in votes]),
+            np.array([v.vote for v in votes]),
+            NOW + 10,
+            wire_votes=[v.encode() for v in votes],
+        )
+        assert (statuses == int(StatusCode.OK)).all()
+
     def test_mixed_scalar_and_columnar_exports_true_arrival_order(self):
         """A session fed through BOTH paths — scalar vote, columnar chunk,
         scalar vote, columnar chunk — must export its votes in true arrival

@@ -331,3 +331,20 @@ def test_two_process_engine_on_multihost_pool(tmp_path):
 
 def test_two_process_multihost_pool(tmp_path):
     _run_two_process(tmp_path, _WORKER, "MULTIHOST_OK")
+
+
+def test_canonical_scope_bytes_rejects_default_repr():
+    """Deterministic multi-host pids hash the scope; a default object repr
+    embeds a memory address and would silently de-sync the replicated
+    control plane, so non-canonical scope types must be a hard error."""
+    from hashgraph_tpu.engine.engine import _canonical_scope_bytes
+
+    assert _canonical_scope_bytes("s") == b"s:s"
+    assert _canonical_scope_bytes(b"s") == b"b:s"
+    assert _canonical_scope_bytes(7) == b"i:7"
+
+    class Opaque:
+        pass
+
+    with pytest.raises(TypeError, match="canonical"):
+        _canonical_scope_bytes(Opaque())

@@ -112,6 +112,87 @@ class TestStaleGids:
         )
         assert statuses[0] == int(StatusCode.EMPTY_VOTE_OWNER)
 
+    def test_columnar_rejects_stale_gid_after_recycling(self):
+        """The generation tag makes a stale gid detectable even after its
+        index has been recycled to a NEW owner — the r4 lifetime contract
+        allowed silent misattribution to the new claimant there; now it is
+        a typed rejection, and the new claimant's own gid is unaffected."""
+        from hashgraph_tpu import StatusCode
+
+        engine = TpuConsensusEngine(
+            random_stub_signer(), capacity=8, voter_capacity=4
+        )
+        request = CreateProposalRequest(
+            name="p", payload=b"", proposal_owner=b"o",
+            expected_voters_count=3, expiration_timestamp=1000,
+            liveness_criteria_yes=True,
+        )
+        first = engine.create_proposal("s", request, NOW)
+        stale = engine.voter_gid(b"old-voter")
+        statuses = engine.ingest_columnar(
+            "s",
+            np.array([first.proposal_id]),
+            np.array([stale]),
+            np.array([True]),
+            NOW + 1,
+        )
+        assert statuses[0] == int(StatusCode.OK)
+        engine.delete_scope("s")  # releases the slot; old-voter's index freed
+        second = engine.create_proposal("s2", request, NOW)
+        fresh = engine.voter_gid(b"new-claimant")  # recycles the index
+        assert (fresh & 0xFFFFFFFF) == (stale & 0xFFFFFFFF)  # same index
+        assert fresh != stale  # different generation
+        statuses = engine.ingest_columnar(
+            "s2",
+            np.array([second.proposal_id, second.proposal_id]),
+            np.array([stale, fresh]),
+            np.array([True, True]),
+            NOW + 1,
+        )
+        assert statuses[0] == int(StatusCode.EMPTY_VOTE_OWNER)
+        assert statuses[1] == int(StatusCode.OK)
+
+    def test_clear_voter_registry_keeps_stale_gids_rejected(self):
+        """The clear raises the generation floor: a pre-clear gid must keep
+        rejecting rather than become bit-identical to the first post-clear
+        claimant's gid."""
+        pool = ProposalPool(4, 4)
+        stale = pool.voter_gid(b"old")
+        pool.clear_voter_registry()
+        fresh = pool.voter_gid(b"new")
+        assert fresh != stale
+        assert pool.gids_live(np.array([stale, fresh])).tolist() == [
+            False, True,
+        ]
+        assert pool.owner_of_gid(fresh) == b"new"
+
+    def test_lanes_for_batch_refuses_freed_and_stale_gids(self):
+        """Pool-layer gate: a freed or stale-generation in-range gid must
+        not claim a lane — storing it would decrement the recycled index's
+        refcount on slot release and could evict a live voter."""
+        pool = ProposalPool(8, 4)
+        slot_a, slot_b = pool.allocate_batch(
+            [b"a", b"b"], n=[3, 3], req=[2, 2], cap=[0, 0],
+            gossip=[True, True], liveness=[True, True],
+            expiry=[NOW + 100] * 2, created_at=[NOW] * 2,
+        )
+        stale = pool.voter_gid(b"v")
+        assert pool.lanes_for_batch(
+            np.array([slot_a]), np.array([stale])
+        ).tolist() == [0]
+        pool.release([slot_a])  # frees v's index
+        assert pool.lanes_for_batch(
+            np.array([slot_b]), np.array([stale])
+        ).tolist() == [-1]
+        fresh = pool.voter_gid(b"w")  # recycles the index, new generation
+        lanes = pool.lanes_for_batch(
+            np.array([slot_b, slot_b]), np.array([stale, fresh])
+        )
+        assert lanes.tolist() == [-1, 0]
+        # Releasing slot_b evicts exactly the one counted reference.
+        pool.release([slot_b])
+        assert pool.live_voter_count == 0
+
 
 class TestEngineChurn:
     def test_rotating_voter_population_holds_registry_steady(self):
