@@ -154,20 +154,22 @@ def run_engine_bench(
         )
         for _ in range(p_count)
     ]
-    gids = np.array(
-        [
-            engine.voter_gid(bytes([1 + (i % 250), i // 250]) + b"\x00" * 18)
-            for i in range(v_count)
-        ],
-        np.int64,
-    )
-    col_gids = np.repeat(gids, p_count)
+    owners = [
+        bytes([1 + (i % 250), i // 250]) + b"\x00" * 18 for i in range(v_count)
+    ]
     col_vals = (np.arange(p_count * v_count) % 2).astype(bool)
 
     ingest_rates, create_rates = [], []
     for cycle in range(cycles + 1):  # first is compile warmup
         engine.delete_scope("s")
         engine.scope("s").with_threshold(1.0).initialize()
+        # Re-intern per cycle: delete_scope evicted the previous cycle's
+        # gids (refcounted registry), so reusing them would measure the
+        # EMPTY_VOTE_OWNER rejection fast path, not vote ingest — the
+        # all-OK assert below guards every timed cycle against exactly
+        # that regression.
+        gids = np.array([engine.voter_gid(o) for o in owners], np.int64)
+        col_gids = np.repeat(gids, p_count)
         t0 = time.perf_counter()
         proposals = engine.create_proposals("s", requests, now)
         t1 = time.perf_counter()
@@ -178,9 +180,8 @@ def run_engine_bench(
         t2 = time.perf_counter()
         statuses = engine.ingest_columnar("s", col_pids, col_gids, col_vals, now)
         t3 = time.perf_counter()
-        if cycle == 0:
-            assert int(np.sum(statuses == 0)) == p_count * v_count, "not all OK"
-        else:
+        assert int(np.sum(statuses == 0)) == p_count * v_count, "not all OK"
+        if cycle > 0:
             create_rates.append(p_count / (t1 - t0))
             ingest_rates.append(p_count * v_count / (t3 - t2))
     ingest_rates.sort()
@@ -239,23 +240,23 @@ def run_engine_lanes1024(
         )
         for _ in range(p_count)
     ]
-    gids = np.array(
-        [
-            engine.voter_gid(bytes([1 + (i % 250), i // 250]) + b"\x00" * 18)
-            for i in range(fill)
-        ],
-        np.int64,
-    )
-    # One fresh (slot, gid) stream per cycle: proposal-major, arrival order
-    # = lane order; every pair is first-occurrence so lane resolution stays
-    # on the vectorized fresh-assignment path.
-    col_gids = np.tile(gids, p_count)
+    owners = [
+        bytes([1 + (i % 250), i // 250]) + b"\x00" * 18 for i in range(fill)
+    ]
     col_vals = rng.random(p_count * fill) < 0.5
 
     ingest_rates, create_rates = [], []
     for cycle in range(cycles + 1):  # first is compile warmup
         engine.delete_scope("s")
         engine.set_scope_config("s", ScopeConfigBuilder().p2p_preset().build())
+        # Re-intern per cycle (delete_scope evicted the previous cycle's
+        # gids); the every-cycle all-OK assert guards against timing the
+        # rejection path as throughput.
+        cycle_gids = np.array([engine.voter_gid(o) for o in owners], np.int64)
+        # One fresh (slot, gid) stream per cycle: proposal-major, arrival
+        # order = lane order; every pair is first-occurrence so lane
+        # resolution stays on the vectorized fresh-assignment path.
+        col_gids = np.tile(cycle_gids, p_count)
         t0 = time.perf_counter()
         proposals = engine.create_proposals("s", requests, now)
         t1 = time.perf_counter()
@@ -264,10 +265,9 @@ def run_engine_lanes1024(
         t2 = time.perf_counter()
         statuses = engine.ingest_columnar("s", col_pids, col_gids, col_vals, now)
         t3 = time.perf_counter()
-        if cycle == 0:
-            ok = int(np.sum(statuses == 0))
-            assert ok == p_count * fill, (ok, p_count * fill)
-        else:
+        ok = int(np.sum(statuses == 0))
+        assert ok == p_count * fill, (ok, p_count * fill)
+        if cycle > 0:
             create_rates.append(p_count / (t1 - t0))
             ingest_rates.append(p_count * fill / (t3 - t2))
     ingest_rates.sort()
@@ -331,10 +331,7 @@ def run_engine_config5(
             )
             engine.set_scope_config(scope, builder.build())
 
-    gids = np.array(
-        [engine.voter_gid(bytes([1 + i]) * 20) for i in range(v_count)],
-        np.int64,
-    )
+    owners = [bytes([1 + i]) * 20 for i in range(v_count)]
     requests = [
         CreateProposalRequest(
             name="p",
@@ -350,6 +347,11 @@ def run_engine_config5(
     def run_wave(wave: int) -> tuple[int, int]:
         """Returns (votes_applied, proposals_registered)."""
         set_configs()
+        # Re-intern per wave: the end-of-wave delete_scope sweep evicts
+        # every gid (refcounted registry), so carrying gids across waves
+        # would measure the EMPTY_VOTE_OWNER rejection path, not churn
+        # (the every-wave applied-fraction assert below enforces this).
+        gids = np.array([engine.voter_gid(o) for o in owners], np.int64)
         # One cross-scope allocate dispatch for the whole wave's population.
         batches = engine.create_proposals_multi(
             [(scope, requests) for scope in scope_names], now
@@ -372,15 +374,16 @@ def run_engine_config5(
         statuses = engine.ingest_columnar_multi(
             scope_names, col_sidx, col_pids, col_gids, col_vals, now
         )
-        if wave < 0:
-            # Warmup wave doubles as the correctness gate: a resolution
-            # regression must fail the bench, not get timed as throughput.
-            # P2P round-cap overruns (24) and their followups (19) are
-            # legitimate in this mixed workload; what must never appear is
-            # an unresolved session (20), and the bulk must apply.
-            assert int(np.sum(statuses == 20)) == 0, "unresolved proposal ids"
-            applied = int(np.sum((statuses == 0) | (statuses == 28)))
-            assert applied >= int(0.9 * len(statuses)), (applied, len(statuses))
+        # Correctness gate on EVERY wave: a resolution or identity
+        # regression must fail the bench, not get timed as throughput.
+        # P2P round-cap overruns (24) and their followups (19) are
+        # legitimate in this mixed workload; what must never appear is
+        # an unresolved session (20) or a rejected voter identity (10),
+        # and the bulk must apply.
+        assert int(np.sum(statuses == 20)) == 0, "unresolved proposal ids"
+        assert int(np.sum(statuses == 10)) == 0, "stale voter gids"
+        applied = int(np.sum((statuses == 0) | (statuses == 28)))
+        assert applied >= int(0.9 * len(statuses)), (applied, len(statuses))
         votes = len(statuses)
         for scope in scope_names:
             engine.delete_scope(scope)
@@ -981,8 +984,6 @@ def run_default() -> dict:
         "engine_config5": run_engine_config5(),
     }
     detail = dict(engine["detail"])
-    detail["headline_repetitions"] = values
-    detail["headline_spread_pct"] = round(spread_pct, 1)
     for name, result in sections.items():
         detail[name] = {
             "metric": result["metric"],
@@ -990,12 +991,20 @@ def run_default() -> dict:
             "unit": result["unit"],
             "detail": result["detail"],
         }
+    # Key order is deliberate: the driver's artifact stores only the TAIL
+    # of this (long) line, so the headline fields and the compact per-
+    # section summary go LAST — the captured artifact then always carries
+    # the headline, vs_baseline, the repetition evidence, and one number
+    # per BASELINE shape even when the full detail is truncated away.
     return {
         "metric": engine["metric"],
-        "value": engine["value"],
         "unit": engine["unit"],
-        "vs_baseline": engine["vs_baseline"],
         "detail": detail,
+        "summary": {name: result["value"] for name, result in sections.items()},
+        "headline_repetitions": values,
+        "headline_spread_pct": round(spread_pct, 1),
+        "value": engine["value"],
+        "vs_baseline": engine["vs_baseline"],
     }
 
 
