@@ -1216,8 +1216,6 @@ class TpuConsensusEngine(Generic[Scope]):
         multi-scope entry points: gid/locality filters, host-spill tallies,
         lane resolution, bounded-depth pipelined device dispatches, round
         bookkeeping, and event emission."""
-        from .pool import group_batch
-
         # Gids must be LIVE current-generation identities (voter_gid):
         # out-of-range, freed, and stale-generation ids (held across a
         # release, even after the index was recycled to a new owner) all get
@@ -1265,94 +1263,155 @@ class TpuConsensusEngine(Generic[Scope]):
                 self._emit(record.scope, event)
 
         dev_rows = np.nonzero(found & (slots >= 0))[0]
-        dslots = slots[dev_rows]
-        lanes = np.empty(0, np.int32)
+
+        # ── Fused sorted-domain pipeline ───────────────────────────────
+        # ONE stable slot-sort of the batch; grouping, lane assignment,
+        # depth segmentation, and round bookkeeping all derive from the
+        # sorted domain. (Previously each stage re-sorted: lanes_for_batch
+        # unique+lexsort, group_batch argsort, one more argsort per depth
+        # segment — ~3x the host time on multi-million-row batches.)
+        def _group(s_sorted: np.ndarray):
+            b = len(s_sorted)
+            is_start = np.empty(b, bool)
+            is_start[0] = True
+            np.not_equal(s_sorted[1:], s_sorted[:-1], out=is_start[1:])
+            starts_idx = np.nonzero(is_start)[0]
+            grp = np.cumsum(is_start) - 1
+            col = np.arange(b) - starts_idx[grp]
+            counts = np.diff(np.append(starts_idx, b))
+            return s_sorted[starts_idx], starts_idx, grp, col, counts
+
+        order = np.empty(0, np.int64)
+        lanes_sorted = np.empty(0, np.int32)
+        vals_sorted = np.empty(0, bool)
+        uniq = starts_idx = grp_sorted = col_sorted = counts = None
         if dev_rows.size:
-            # assume_live: this batch already passed the gids_live gate
-            # above — skip the pool's duplicate O(B) liveness pass.
-            lanes = self._pool.lanes_for_batch(
-                dslots, voter_gids[dev_rows], assume_live=True
+            dslots = slots[dev_rows]
+            order = np.argsort(dslots, kind="stable")
+            s_sorted = dslots[order]
+            uniq, starts_idx, grp_sorted, col_sorted, counts = _group(s_sorted)
+            gid_idx_sorted = voter_gids[dev_rows][order] & 0xFFFFFFFF
+            lanes_sorted = self._pool.fresh_lanes_grouped(
+                s_sorted, gid_idx_sorted, col_sorted, uniq, counts
             )
-            no_lane = lanes < 0
+            if lanes_sorted is None:
+                # General path (pre-voted slots or an in-batch duplicate
+                # voter); assume_live: the gids_live gate above ran.
+                lanes_sorted = self._pool.lanes_for_batch(
+                    dslots, voter_gids[dev_rows], assume_live=True
+                )[order]
+            no_lane = lanes_sorted < 0
             if no_lane.any():
-                statuses[dev_rows[no_lane]] = int(
+                statuses[dev_rows[order[no_lane]]] = int(
                     StatusCode.VOTER_CAPACITY_EXCEEDED
                 )
-                dev_rows = dev_rows[~no_lane]
-                dslots = dslots[~no_lane]
-                lanes = lanes[~no_lane]
-        dvals = values[dev_rows]
+                keep = ~no_lane
+                order = order[keep]
+                s_sorted = s_sorted[keep]
+                lanes_sorted = lanes_sorted[keep]
+                if len(order):
+                    uniq, starts_idx, grp_sorted, col_sorted, counts = _group(
+                        s_sorted
+                    )
+            vals_sorted = values[dev_rows][order]
 
-        # Bounded-depth pipelining: the kernel's scan length is the deepest
-        # per-slot chain in a dispatch; segmenting by per-slot occurrence
-        # index keeps every dispatch at depth <= max_depth and lets the
-        # async queue overlap transfers with device compute.
-        seg_members: list[np.ndarray]
-        if dev_rows.size:
-            _, _, col, depth = group_batch(dslots)
+        # Bounded-depth pipelining, sort-free: in the sorted domain each
+        # slot's items are contiguous and arrival-ordered, so segment k
+        # (votes [k*D, (k+1)*D) of every slot) is a repeat/arange gather —
+        # no per-segment re-sort. Segmenting keeps every dispatch's scan
+        # depth <= max_depth and lets the async queue overlap transfers
+        # with device compute.
+        segs: list[tuple] = []  # (uniq_k, rows_k, cols_k, depth_k, idx_k)
+        if len(order):
+            depth = int(counts.max())
             if depth > max_depth:
-                segs = col // max_depth
-                n_seg = int(segs.max()) + 1
-                order = np.argsort(segs, kind="stable")  # arrival order per segment
-                bounds = np.searchsorted(segs[order], np.arange(1, n_seg))
-                seg_members = np.split(order, bounds)
+                d = max_depth
+                for k in range(-(-depth // d)):
+                    sel = counts > k * d
+                    g_starts = starts_idx[sel] + k * d
+                    g_lens = np.minimum(counts[sel] - k * d, d)
+                    m = int(g_lens.sum())
+                    off = np.zeros(len(g_lens) + 1, np.int64)
+                    np.cumsum(g_lens, out=off[1:])
+                    local = np.arange(m, dtype=np.int64) - np.repeat(
+                        off[:-1], g_lens
+                    )
+                    idx_k = np.repeat(g_starts, g_lens) + local
+                    rows_k = np.repeat(
+                        np.arange(int(sel.sum()), dtype=np.int64), g_lens
+                    )
+                    # Uniform depth d (not g_lens.max()): a shallower final
+                    # segment would give its output a different shape,
+                    # splitting complete_all's single stacked readback into
+                    # two transfers. Pad columns are valid=0, inert.
+                    segs.append((uniq[sel], rows_k, local, d, idx_k))
             else:
-                seg_members = [np.arange(dev_rows.size)]
-        else:
-            seg_members = []
+                segs.append(
+                    (
+                        uniq,
+                        grp_sorted,
+                        col_sorted,
+                        depth,
+                        np.arange(len(order), dtype=np.int64),
+                    )
+                )
         if self._multihost:
             # Collective cadence: every process must issue the same number
             # of dispatches this call, empty ones included.
             from jax.experimental import multihost_utils
 
             agreed = multihost_utils.process_allgather(
-                np.array([len(seg_members)], np.int64)
+                np.array([len(segs)], np.int64)
             )
-            for _ in range(int(np.max(agreed)) - len(seg_members)):
-                seg_members.append(np.empty(0, np.int64))
-        if not seg_members:
+            empty = np.empty(0, np.int64)
+            for _ in range(int(np.max(agreed)) - len(segs)):
+                segs.append((empty, empty, empty, 0, empty))
+        if not segs:
             return statuses
 
         pendings = []
-        for members in seg_members:
+        orig_of = []  # statuses rows per pending, in dispatch item order
+        for uniq_k, rows_k, cols_k, depth_k, idx_k in segs:
             pendings.append(
-                self._pool.ingest_async(
-                    dslots[members], lanes[members], dvals[members], now
+                self._pool.ingest_async_grouped(
+                    uniq_k,
+                    rows_k,
+                    cols_k,
+                    depth_k,
+                    lanes_sorted[idx_k],
+                    vals_sorted[idx_k],
+                    now,
                 )
             )
-        with self.tracer.span("engine.device_ingest", votes=int(dev_rows.size)):
+            orig_of.append(dev_rows[order[idx_k]])
+        with self.tracer.span("engine.device_ingest", votes=int(len(order))):
             results = self._pool.complete_all(pendings)
 
         accepted = 0
         reached_transitions: list[tuple[int, int]] = []
-        already_per_slot: dict[int, int] = {}
         n_transitions = 0
-        for members, (seg_statuses, transitions) in zip(seg_members, results):
-            statuses[dev_rows[members]] = seg_statuses
+        for orig_rows, (seg_statuses, transitions) in zip(orig_of, results):
+            statuses[orig_rows] = seg_statuses
             accepted += int(np.sum(seg_statuses == int(StatusCode.OK)))
             n_transitions += len(transitions)
             for slot, new_state in transitions:
                 if new_state in (STATE_REACHED_YES, STATE_REACHED_NO):
                     reached_transitions.append((slot, new_state))
-            ar_mask = seg_statuses == int(StatusCode.ALREADY_REACHED)
-            if ar_mask.any():
-                ar_slots, ar_counts = np.unique(
-                    dslots[members][ar_mask], return_counts=True
-                )
-                for slot, c in zip(ar_slots.tolist(), ar_counts.tolist()):
-                    already_per_slot[slot] = already_per_slot.get(slot, 0) + c
         self.tracer.count("engine.votes_accepted", accepted)
         self.tracer.count("engine.transitions", n_transitions)
 
-        # Round bookkeeping, one pass per touched slot (host mirror of the
-        # device round update; totals are order-independent).
-        ok_mask = statuses[dev_rows] == int(StatusCode.OK)
-        if ok_mask.any():
-            ok_slots, ok_counts = np.unique(
-                dslots[ok_mask], return_counts=True
-            )
-            for slot, c in zip(ok_slots.tolist(), ok_counts.tolist()):
-                self._records[slot].bump_round(int(c))
+        # Round + late-vote bookkeeping per touched slot, via bincount over
+        # the sorted-domain group index (no re-sort; totals are
+        # order-independent).
+        sorted_statuses = (
+            statuses[dev_rows[order]] if len(order) else np.empty(0, np.int32)
+        )
+        if len(order):
+            ok_m = sorted_statuses == int(StatusCode.OK)
+            if ok_m.any():
+                cnt = np.bincount(grp_sorted[ok_m], minlength=len(uniq))
+                for g in np.nonzero(cnt)[0].tolist():
+                    self._records[int(uniq[g])].bump_round(int(cnt[g]))
 
         # Events: one ConsensusReached per deciding transition plus one per
         # late (ALREADY_REACHED) vote — same per-session counts as the
@@ -1367,16 +1426,21 @@ class TpuConsensusEngine(Generic[Scope]):
                     timestamp=now,
                 ),
             )
-        for slot, count in already_per_slot.items():
-            record = self._records[slot]
-            state = self._pool.state_of(slot)
-            event = ConsensusReached(
-                proposal_id=record.proposal.proposal_id,
-                result=state == STATE_REACHED_YES,
-                timestamp=now,
-            )
-            for _ in range(count):
-                self._emit(record.scope, event)
+        if len(order):
+            ar_m = sorted_statuses == int(StatusCode.ALREADY_REACHED)
+            if ar_m.any():
+                cnt = np.bincount(grp_sorted[ar_m], minlength=len(uniq))
+                for g in np.nonzero(cnt)[0].tolist():
+                    slot = int(uniq[g])
+                    record = self._records[slot]
+                    state = self._pool.state_of(slot)
+                    event = ConsensusReached(
+                        proposal_id=record.proposal.proposal_id,
+                        result=state == STATE_REACHED_YES,
+                        timestamp=now,
+                    )
+                    for _ in range(int(cnt[g])):
+                        self._emit(record.scope, event)
         return statuses
 
     def _pid_lookup(self, scope: Scope) -> "_PidLookup":

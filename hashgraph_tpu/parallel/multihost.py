@@ -183,8 +183,10 @@ class MultiHostPool(ShardedPool):
     def ingest_async(self, slots, lanes, values, now):
         """Collective dispatch; ``slots`` must all be process-local. Unlike
         the single-host pools an EMPTY batch still dispatches (the other
-        processes' batches are part of the same global program)."""
-        from ..ops.ingest import group_batch, pack_grid, pack_slots
+        processes' batches are part of the same global program) — the
+        inherited grouped path dispatches unconditionally, preserving that.
+        """
+        from ..ops.ingest import group_batch
 
         slots = np.asarray(slots, np.int64)
         lo, hi = self.local_slots()
@@ -194,25 +196,9 @@ class MultiHostPool(ShardedPool):
                 f"[{lo}, {hi})); route votes to the owning host first"
             )
         uniq, row, col, depth = group_batch(slots)
-        s_count = len(uniq)
-        voter_grid = np.zeros((s_count, max(depth, 1)), np.int32)
-        valbit = np.zeros((s_count, max(depth, 1)), np.int32)
-        if slots.size:
-            voter_grid[row, col] = np.asarray(lanes, np.int32)
-            valbit[row, col] = np.asarray(values, np.int32) | 2
-        grid = pack_grid(voter_grid, valbit & 1, valbit >> 1)
-        expired = self._expiry_host[uniq] <= now
-
-        out, row_select = self._dispatch_ingest(
-            pack_slots(uniq.astype(np.int32), expired), grid
+        return self.ingest_async_grouped(
+            uniq, row, col, depth, lanes, values, now
         )
-        from ..engine.pool import PendingIngest
-
-        pending = PendingIngest(
-            out=out, uniq=uniq, row=row, col=col, row_select=row_select
-        )
-        self._inflight.append(pending)
-        return pending
 
     def _dispatch_ingest(self, slot_pack, grid_pack):
         from ..engine.pool import _bucket, _pad2, _pad_slot_ids
