@@ -297,6 +297,7 @@ def run_engine_config5(
     proposals_per_scope: int = 128,
     v_count: int = 48,
     waves: int = 4,
+    retain: bool = False,
 ) -> dict:
     """Engine-level config 5: mixed-scope streaming churn. Every wave
     registers 256 scopes' worth of fresh proposals (half gossipsub, half
@@ -371,8 +372,19 @@ def run_engine_config5(
         col_sidx = np.repeat(sidx[order], present)
         col_gids = np.tile(gids[:present], p_count)
         col_vals = rng.random(p_count * present) < 0.55
+        wire = None
+        if retain:
+            # Synthetic fixed-width vote bytes: retention stores verbatim
+            # bytes without decoding (decode happens on export), so dummy
+            # payloads price the retention machinery itself.
+            width = 72
+            wire = (
+                np.zeros(p_count * present * width, np.uint8),
+                np.arange(p_count * present + 1, dtype=np.int64) * width,
+            )
         statuses = engine.ingest_columnar_multi(
-            scope_names, col_sidx, col_pids, col_gids, col_vals, now
+            scope_names, col_sidx, col_pids, col_gids, col_vals, now,
+            wire_votes=wire,
         )
         # Correctness gate on EVERY wave: a resolution or identity
         # regression must fail the bench, not get timed as throughput.
@@ -385,8 +397,7 @@ def run_engine_config5(
         applied = int(np.sum((statuses == 0) | (statuses == 28)))
         assert applied >= int(0.9 * len(statuses)), (applied, len(statuses))
         votes = len(statuses)
-        for scope in scope_names:
-            engine.delete_scope(scope)
+        engine.delete_scopes(scope_names)  # one release dispatch, not 256
         return votes, p_count
 
     run_wave(-1)  # warmup/compile
@@ -399,7 +410,8 @@ def run_engine_config5(
     elapsed = time.perf_counter() - start
     throughput = total_votes / elapsed
     return {
-        "metric": "engine_mixed_scope_churn_throughput",
+        "metric": "engine_mixed_scope_churn_throughput"
+        + ("_retained" if retain else ""),
         "value": round(throughput, 1),
         "unit": "votes/sec",
         "vs_baseline": round(throughput / 1_000_000, 4),
@@ -871,8 +883,7 @@ def run_engine_config4(
         }
 
     warm = run_round(0)
-    for scope in warm["scope_names"]:
-        engine.delete_scope(scope)
+    engine.delete_scopes(warm["scope_names"])
     timed = run_round(1)
 
     throughput = timed["votes"] / timed["seconds"]
@@ -1043,6 +1054,7 @@ if __name__ == "__main__":
         "engine_config4": run_engine_config4,
         "config5": run_config5,
         "engine_config5": run_engine_config5,
+        "engine_config5_retained": lambda: run_engine_config5(retain=True),
         "lanes1024": run_lanes1024,
         "engine_lanes1024": run_engine_lanes1024,
         "crypto": run_crypto,

@@ -1051,16 +1051,28 @@ class TpuConsensusEngine(Generic[Scope]):
         order (reference semantics per code, as ingest_votes).
         """
         proposal_ids = np.asarray(proposal_ids, np.int64)
+        voter_gids = np.asarray(voter_gids, np.int64)
+        values = np.asarray(values, bool)
+        batch = len(proposal_ids)
         wire_norm = (
-            self._normalize_wire(wire_votes, len(proposal_ids))
+            self._normalize_wire(wire_votes, batch)
             if wire_votes is not None
             else None
         )
-        statuses = self._ingest_columnar_apply(
-            scope, proposal_ids, voter_gids, values, now, max_depth
+        self.tracer.count("engine.votes_in", batch)
+        statuses = np.full(batch, int(StatusCode.SESSION_NOT_FOUND), np.int32)
+        if batch == 0 and not self._multihost:
+            # Multi-host must fall through: an empty local batch still joins
+            # the fleet's agreed dispatch cadence (allgather + padding in
+            # _columnar_apply).
+            return statuses
+
+        found, slots = self._pid_lookup(scope).lookup(proposal_ids)
+        statuses = self._columnar_apply(
+            slots, found, voter_gids, values, now, max_depth, statuses
         )
         if wire_norm is not None:
-            self._retain_wire(scope, statuses, proposal_ids, wire_norm)
+            self._retain_wire_slots(statuses, slots, wire_norm)
         return statuses
 
     @staticmethod
@@ -1092,27 +1104,52 @@ class TpuConsensusEngine(Generic[Scope]):
             )
         return data_arr, offsets
 
-    def _retain_wire(
+    def _retain_wire_slots(
         self,
-        scope: Scope,
         statuses: np.ndarray,
-        proposal_ids: np.ndarray,
+        slots: np.ndarray,
         wire_norm: tuple[np.ndarray, np.ndarray],
     ) -> None:
         """Attach accepted rows' verbatim vote bytes to their session
-        records (vectorized gather; one Python iteration per touched
-        session, not per vote)."""
+        records, keyed by the already-resolved slots (vectorized gather;
+        one Python iteration per touched session, not per vote). Shared by
+        the single- and multi-scope columnar entry points — slots identify
+        records directly, so retention is scope-agnostic."""
         ok_rows = np.nonzero(statuses == int(StatusCode.OK))[0]
         if ok_rows.size == 0:
             return
         data_arr, offsets = wire_norm
-        # An OK status implies the pid resolved, so the lookup hit is exact.
-        _, slots = self._pid_lookup(scope).lookup(proposal_ids[ok_rows])
-        order = np.argsort(slots, kind="stable")  # keeps arrival order per slot
+        ok_slots = slots[ok_rows]
+        order = np.argsort(ok_slots, kind="stable")  # arrival order per slot
         rows = ok_rows[order]
-        s_sorted = slots[order]
+        s_sorted = ok_slots[order]
         starts = offsets[rows]
         lens = offsets[rows + 1] - starts
+        ends = starts + lens
+        uniq, seg_start = np.unique(s_sorted, return_index=True)
+        seg_bounds = np.append(seg_start, len(rows))
+
+        # Fast path: every slot's accepted rows occupy one contiguous span
+        # of the packed data (the common streaming layout — batch packed in
+        # arrival order, slot-major). Each slot's blob is then ONE slice;
+        # the general path below materializes a per-byte gather index,
+        # which is ~len(data) int64 entries of host work.
+        contig = np.ones(len(rows), bool)
+        if len(rows) > 1:
+            contig[1:] = starts[1:] == ends[:-1]
+            contig[seg_start] = True  # span breaks at slot boundaries are fine
+        if contig.all():
+            for k, slot in enumerate(uniq.tolist()):
+                lo, hi = int(seg_bounds[k]), int(seg_bounds[k + 1])
+                base = int(starts[lo])
+                seg_off = np.append(starts[lo:hi], ends[hi - 1]) - base
+                seg_blob = data_arr[base : int(ends[hi - 1])].tobytes()
+                record = self._records[int(slot)]
+                record.retained_wire.append(
+                    (record.next_arrival_seq(), seg_blob, seg_off)
+                )
+            return
+
         out_off = np.zeros(len(rows) + 1, np.int64)
         np.cumsum(lens, out=out_off[1:])
         gather = (
@@ -1121,8 +1158,6 @@ class TpuConsensusEngine(Generic[Scope]):
             + np.repeat(starts, lens)
         )
         blob = data_arr[gather]
-        uniq, seg_start = np.unique(s_sorted, return_index=True)
-        seg_bounds = np.append(seg_start, len(rows))
         for k, slot in enumerate(uniq.tolist()):
             lo, hi = int(seg_bounds[k]), int(seg_bounds[k + 1])
             seg_off = (out_off[lo : hi + 1] - out_off[lo]).copy()
@@ -1141,6 +1176,7 @@ class TpuConsensusEngine(Generic[Scope]):
         values: np.ndarray,
         now: int,
         max_depth: int = 8,
+        wire_votes: "list[bytes] | tuple[bytes, np.ndarray] | None" = None,
     ) -> np.ndarray:
         """Mixed-scope columnar ingest: one fused device pipeline across
         many scopes (BASELINE config-5 churn shape). ``scopes`` lists the
@@ -1148,13 +1184,20 @@ class TpuConsensusEngine(Generic[Scope]):
         Per-scope work is only the proposal-id resolution — one _PidLookup
         hash probe pass per scope — so a 256-scope stream costs 256 cheap
         vectorized lookups, not 256 device dispatches; lanes, dispatch
-        segmentation, statuses, and events are shared with
+        segmentation, statuses, events, and opt-in ``wire_votes`` retention
+        (accepted rows' verbatim bytes, re-embedded chain-valid on export —
+        reference: src/utils.rs:175-215) are shared with
         :meth:`ingest_columnar`."""
         proposal_ids = np.asarray(proposal_ids, np.int64)
         scope_idx = np.asarray(scope_idx, np.int64)
         voter_gids = np.asarray(voter_gids, np.int64)
         values = np.asarray(values, bool)
         batch = len(proposal_ids)
+        wire_norm = (
+            self._normalize_wire(wire_votes, batch)
+            if wire_votes is not None
+            else None
+        )
         self.tracer.count("engine.votes_in", batch)
         statuses = np.full(batch, int(StatusCode.SESSION_NOT_FOUND), np.int32)
         if batch == 0 and not self._multihost:
@@ -1172,35 +1215,12 @@ class TpuConsensusEngine(Generic[Scope]):
             hit, hit_slots = self._pid_lookup(scope).lookup(proposal_ids[rows])
             found[rows] = hit
             slots[rows] = hit_slots
-        return self._columnar_apply(
+        statuses = self._columnar_apply(
             slots, found, voter_gids, values, now, max_depth, statuses
         )
-
-    def _ingest_columnar_apply(
-        self,
-        scope: Scope,
-        proposal_ids: np.ndarray,
-        voter_gids: np.ndarray,
-        values: np.ndarray,
-        now: int,
-        max_depth: int = 8,
-    ) -> np.ndarray:
-        proposal_ids = np.asarray(proposal_ids, np.int64)
-        voter_gids = np.asarray(voter_gids, np.int64)
-        values = np.asarray(values, bool)
-        batch = len(proposal_ids)
-        self.tracer.count("engine.votes_in", batch)
-        statuses = np.full(batch, int(StatusCode.SESSION_NOT_FOUND), np.int32)
-        if batch == 0 and not self._multihost:
-            # Multi-host must fall through: an empty local batch still joins
-            # the fleet's agreed dispatch cadence (allgather + padding in
-            # _columnar_apply).
-            return statuses
-
-        found, slots = self._pid_lookup(scope).lookup(proposal_ids)
-        return self._columnar_apply(
-            slots, found, voter_gids, values, now, max_depth, statuses
-        )
+        if wire_norm is not None:
+            self._retain_wire_slots(statuses, slots, wire_norm)
+        return statuses
 
     def _columnar_apply(
         self,
@@ -1834,14 +1854,26 @@ class TpuConsensusEngine(Generic[Scope]):
     def delete_scope(self, scope: Scope) -> None:
         """Drop every session and the config of a scope
         (reference: src/storage.rs:92 delete_scope semantics)."""
-        slots = self._scopes.pop(scope, [])
-        for slot in slots:
-            record = self._records.pop(slot)
-            del self._index[(scope, record.proposal.proposal_id)]
-        self._pool.release([s for s in slots if s >= 0])  # host spills have no slot
-        self._scope_configs.pop(scope, None)
-        self._pid_tables.pop(scope, None)
-        self._pid_hashes.pop(scope, None)
+        self.delete_scopes([scope])
+
+    def delete_scopes(self, scopes: "list[Scope]") -> None:
+        """Batched delete_scope: ONE pool release dispatch (and one lane
+        retirement pass) covers every scope's sessions — the teardown half
+        of the config-5 churn shape (mirror of create_proposals_multi,
+        which batches the registration half). Observable semantics are
+        identical to calling delete_scope once per scope."""
+        all_slots: list[int] = []
+        for scope in scopes:
+            slots = self._scopes.pop(scope, [])
+            for slot in slots:
+                record = self._records.pop(slot)
+                del self._index[(scope, record.proposal.proposal_id)]
+            # Host spills (slot < 0) have no pool slot to release.
+            all_slots.extend(s for s in slots if s >= 0)
+            self._scope_configs.pop(scope, None)
+            self._pid_tables.pop(scope, None)
+            self._pid_hashes.pop(scope, None)
+        self._pool.release(all_slots)
 
     # ── Scope config (reference: src/service.rs:375-484) ───────────────
 
@@ -2110,6 +2142,7 @@ for _name in (
     "save_to_storage",
     "load_from_storage",
     "delete_scope",
+    "delete_scopes",
     "set_scope_config",
     "get_scope_config",
     "_initialize_scope",

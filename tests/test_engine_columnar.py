@@ -671,6 +671,58 @@ class TestWireRetention:
 
         validate_vote_chain(exported.votes)
 
+    def test_multi_scope_churn_regossip_chain_validates(self):
+        """wire_votes on ingest_columnar_multi (config-5 churn shape): a
+        256-scope mixed batch retains per-row bytes, and every scope's
+        proposal re-gossips with a chain-valid vote list that a second
+        engine fully validates (reference: src/utils.rs:175-215). Before
+        r5 the multi-scope entry point had no wire_votes parameter, so
+        streaming deployments had to fall back to per-scope calls."""
+        from hashgraph_tpu import Proposal
+
+        n_scopes = 256
+        engine_a = make_engine(capacity=512, voter_capacity=8)
+        engine_b = make_engine(capacity=512, voter_capacity=8)
+        scopes = [f"s{i}" for i in range(n_scopes)]
+        batches = engine_a.create_proposals_multi(
+            [(s, [request(n=4)]) for s in scopes], NOW
+        )
+        signers = [random_stub_signer() for _ in range(3)]
+        col_pids, col_sidx, col_gids, col_vals, wire = [], [], [], [], []
+        votes_of = {}
+        for k, (scope, (proposal,)) in enumerate(zip(scopes, batches)):
+            votes = self._chained_votes(proposal, signers, NOW + 1)
+            votes_of[scope] = votes
+            for v in votes:
+                col_pids.append(proposal.proposal_id)
+                col_sidx.append(k)
+                col_gids.append(engine_a.voter_gid(v.vote_owner))
+                col_vals.append(v.vote)
+                wire.append(v.encode())
+        statuses = engine_a.ingest_columnar_multi(
+            scopes,
+            np.array(col_sidx, np.int64),
+            np.array(col_pids, np.int64),
+            np.array(col_gids, np.int64),
+            np.array(col_vals, bool),
+            NOW + 10,
+            wire_votes=wire,
+        )
+        assert (statuses == int(StatusCode.OK)).all(), statuses
+        for k, (scope, (proposal,)) in enumerate(zip(scopes, batches)):
+            exported = engine_a.get_proposal(scope, proposal.proposal_id)
+            assert len(exported.votes) == 3
+            assert [v.vote_owner for v in exported.votes] == [
+                v.vote_owner for v in votes_of[scope]
+            ]
+            engine_b.process_incoming_proposal(
+                scope, Proposal.decode(exported.encode()), NOW + 11
+            )
+            assert (
+                engine_b.get_consensus_result(scope, proposal.proposal_id)
+                is True
+            )
+
     def test_malformed_offsets_fail_before_any_state_mutates(self):
         """A (packed, offsets) pair with negative or non-monotone offsets
         must fail the whole call up front — not apply votes and then strand

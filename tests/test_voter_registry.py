@@ -194,6 +194,98 @@ class TestStaleGids:
         assert pool.live_voter_count == 0
 
 
+class TestSteadyStateSoak:
+    def test_churn_waves_hold_every_resource_steady(self):
+        """Leak regression gate: config-5-style churn waves (multi-scope
+        registration -> columnar ingest with wire retention -> scope
+        deletion) must hold the voter registry, pid tables, record/index
+        maps, retained-wire bytes, free-list, and host heap steady across
+        waves — any unbounded growth fails the assertions, not just a
+        documentation claim. (tracemalloc, not ru_maxrss: the latter is a
+        process-lifetime high-water mark that earlier tests in the same
+        run would mask.)"""
+        import tracemalloc
+
+        engine = TpuConsensusEngine(
+            random_stub_signer(), capacity=128, voter_capacity=8
+        )
+        scopes = [f"s{i}" for i in range(16)]
+        request = CreateProposalRequest(
+            name="p", payload=b"", proposal_owner=b"o",
+            expected_voters_count=4, expiration_timestamp=1000,
+            liveness_criteria_yes=True,
+        )
+
+        def wave(w: int) -> dict:
+            # All interned owners vote: an interned-but-never-voted id has
+            # no slot references to trigger eviction (documented; reclaim
+            # via clear_voter_registry at a quiesce point).
+            owners = [b"w%03d-v%d" % (w, i) for i in range(3)]
+            gids = np.array([engine.voter_gid(o) for o in owners], np.int64)
+            batches = engine.create_proposals_multi(
+                [(s, [request] * 4) for s in scopes], NOW
+            )
+            pids, sidx = [], []
+            for k, proposals in enumerate(batches):
+                pids.extend(p.proposal_id for p in proposals)
+                sidx.extend([k] * len(proposals))
+            pids = np.repeat(np.array(pids, np.int64), 3)
+            sidx = np.repeat(np.array(sidx, np.int64), 3)
+            col_gids = np.tile(gids[:3], 16 * 4)
+            vals = np.ones(len(pids), bool)
+            width = 40
+            statuses = engine.ingest_columnar_multi(
+                scopes, sidx, pids, col_gids, vals, NOW + 1,
+                wire_votes=(
+                    np.zeros(len(pids) * width, np.uint8),
+                    np.arange(len(pids) + 1, dtype=np.int64) * width,
+                ),
+            )
+            assert (statuses == int(StatusCode.OK)).all()
+            engine.delete_scopes(scopes)
+            pool = engine.pool()
+            retained_bytes = sum(
+                len(blob)
+                for record in engine._records.values()
+                for _, blob, _ in record.retained_wire
+            )
+            return {
+                "gid_space": pool.voter_gid_count,
+                "live_voters": pool.live_voter_count,
+                "free_slots": pool.free_slots,
+                "records": len(engine._records),
+                "index": len(engine._index),
+                "pid_tables": len(engine._pid_tables),
+                "retained_bytes": retained_bytes,
+                "heap": tracemalloc.get_traced_memory()[0],
+            }
+
+        from hashgraph_tpu import StatusCode
+
+        tracemalloc.start()
+        try:
+            baseline = None
+            for w in range(12):
+                snap = wave(w)
+                if w < 2:
+                    baseline = snap  # allow first-wave warmup allocations
+                    continue
+                assert snap["gid_space"] <= 16, snap
+                assert snap["live_voters"] <= 8, snap
+                assert snap["free_slots"] == 128, snap
+                assert snap["records"] == 0, snap
+                assert snap["index"] == 0, snap
+                assert snap["pid_tables"] == 0, snap
+                assert snap["retained_bytes"] == 0, snap
+                # Steady state: the live heap stops climbing after warmup
+                # (1 MB slack for allocator/cache noise).
+                assert snap["heap"] <= baseline["heap"] + 1_048_576, (
+                    snap["heap"], baseline["heap"],
+                )
+        finally:
+            tracemalloc.stop()
+
+
 class TestEngineChurn:
     def test_rotating_voter_population_holds_registry_steady(self):
         """100 generations of 8 fresh voters each; scope deletion after each
