@@ -1053,7 +1053,26 @@ class TpuConsensusEngine(Generic[Scope]):
         proposal_ids = np.asarray(proposal_ids, np.int64)
         voter_gids = np.asarray(voter_gids, np.int64)
         values = np.asarray(values, bool)
-        batch = len(proposal_ids)
+        wire_norm, statuses, done = self._columnar_preamble(
+            len(proposal_ids), wire_votes
+        )
+        if done:
+            return statuses
+        found, slots = self._pid_lookup(scope).lookup(proposal_ids)
+        return self._columnar_finish(
+            slots, found, voter_gids, values, now, max_depth, statuses,
+            wire_norm,
+        )
+
+    def _columnar_preamble(
+        self, batch: int, wire_votes
+    ) -> "tuple[tuple[np.ndarray, np.ndarray] | None, np.ndarray, bool]":
+        """Shared entry sequence of the columnar paths: normalize wire
+        bytes BEFORE any state mutates, count the batch, init statuses.
+        The returned ``done`` flag short-circuits empty single-host
+        batches; multi-host must NOT shortcut — an empty local batch still
+        joins the fleet's agreed dispatch cadence (allgather + padding in
+        _columnar_apply)."""
         wire_norm = (
             self._normalize_wire(wire_votes, batch)
             if wire_votes is not None
@@ -1061,13 +1080,21 @@ class TpuConsensusEngine(Generic[Scope]):
         )
         self.tracer.count("engine.votes_in", batch)
         statuses = np.full(batch, int(StatusCode.SESSION_NOT_FOUND), np.int32)
-        if batch == 0 and not self._multihost:
-            # Multi-host must fall through: an empty local batch still joins
-            # the fleet's agreed dispatch cadence (allgather + padding in
-            # _columnar_apply).
-            return statuses
+        return wire_norm, statuses, batch == 0 and not self._multihost
 
-        found, slots = self._pid_lookup(scope).lookup(proposal_ids)
+    def _columnar_finish(
+        self,
+        slots: np.ndarray,
+        found: np.ndarray,
+        voter_gids: np.ndarray,
+        values: np.ndarray,
+        now: int,
+        max_depth: int,
+        statuses: np.ndarray,
+        wire_norm: "tuple[np.ndarray, np.ndarray] | None",
+    ) -> np.ndarray:
+        """Shared tail of the columnar paths: apply, then retain accepted
+        rows' wire bytes keyed by the resolved slots."""
         statuses = self._columnar_apply(
             slots, found, voter_gids, values, now, max_depth, statuses
         )
@@ -1193,14 +1220,8 @@ class TpuConsensusEngine(Generic[Scope]):
         voter_gids = np.asarray(voter_gids, np.int64)
         values = np.asarray(values, bool)
         batch = len(proposal_ids)
-        wire_norm = (
-            self._normalize_wire(wire_votes, batch)
-            if wire_votes is not None
-            else None
-        )
-        self.tracer.count("engine.votes_in", batch)
-        statuses = np.full(batch, int(StatusCode.SESSION_NOT_FOUND), np.int32)
-        if batch == 0 and not self._multihost:
+        wire_norm, statuses, done = self._columnar_preamble(batch, wire_votes)
+        if done:
             return statuses
         found = np.zeros(batch, bool)
         slots = np.zeros(batch, np.int64)
@@ -1215,12 +1236,10 @@ class TpuConsensusEngine(Generic[Scope]):
             hit, hit_slots = self._pid_lookup(scope).lookup(proposal_ids[rows])
             found[rows] = hit
             slots[rows] = hit_slots
-        statuses = self._columnar_apply(
-            slots, found, voter_gids, values, now, max_depth, statuses
+        return self._columnar_finish(
+            slots, found, voter_gids, values, now, max_depth, statuses,
+            wire_norm,
         )
-        if wire_norm is not None:
-            self._retain_wire_slots(statuses, slots, wire_norm)
-        return statuses
 
     def _columnar_apply(
         self,

@@ -27,21 +27,55 @@ _lib: ctypes.CDLL | None = None
 _load_attempted = False
 
 
-def _build() -> bool:
+def _cpu_tag() -> str:
+    """Fingerprint of this host's ISA extensions: a -march=native build from
+    a different host would SIGILL at the first AVX/ADX instruction, so the
+    artifact is stamped with the builder's tag and rebuilt on mismatch."""
+    import hashlib
+    import platform
+
     try:
-        os.makedirs(os.path.dirname(_DEFAULT_SO), exist_ok=True)
-        subprocess.run(
-            [
-                "g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
-                "-o", _DEFAULT_SO, _SOURCE,
-            ],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
-        return True
-    except Exception:
-        return False
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    return hashlib.sha256(line.encode()).hexdigest()[:16]
+    except OSError:
+        pass
+    return platform.machine()
+
+
+def _build() -> bool:
+    base = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread"]
+    # -march=native enables ADX/BMI2 (mulx/adcx) codegen for the 256-bit
+    # field arithmetic — a large ECDSA win; retry portable if rejected.
+    for flags in ([*base, "-march=native"], base):
+        try:
+            os.makedirs(os.path.dirname(_DEFAULT_SO), exist_ok=True)
+            subprocess.run(
+                [*flags, "-o", _DEFAULT_SO, _SOURCE],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            try:
+                with open(_DEFAULT_SO + ".cputag", "w") as fh:
+                    fh.write(_cpu_tag())
+            except OSError:
+                pass
+            return True
+        except Exception:
+            continue
+    return False
+
+
+def _host_mismatch(path: str) -> bool:
+    """True when the cached artifact was built on a host with different ISA
+    extensions (shared/copied checkout on a heterogeneous fleet)."""
+    try:
+        with open(path + ".cputag") as fh:
+            return fh.read().strip() != _cpu_tag()
+    except OSError:
+        return False  # untagged artifact: assume portable (pre-tag builds)
 
 
 def _load() -> ctypes.CDLL | None:
@@ -51,9 +85,12 @@ def _load() -> ctypes.CDLL | None:
             return _lib
         _load_attempted = True
         path = os.environ.get("HASHGRAPH_TPU_NATIVE", _DEFAULT_SO)
-        if not os.path.exists(path):
-            # Only auto-build the default artifact; an explicit env override
-            # pointing at a missing file is the caller's mistake to surface.
+        if not os.path.exists(path) or (
+            path == _DEFAULT_SO and _host_mismatch(path)
+        ):
+            # Only auto-(re)build the default artifact; an explicit env
+            # override pointing at a missing or foreign file is the
+            # caller's mistake to surface.
             if path != _DEFAULT_SO or not os.path.exists(_SOURCE) or not _build():
                 return None
         try:

@@ -5,6 +5,17 @@
 set -e
 cd "$(dirname "$0")"
 mkdir -p build
-g++ -O3 -fPIC -shared -std=c++17 -pthread \
-    -o build/libconsensus_native.so consensus_native.cpp
+# -march=native lets gcc use ADX/BMI2 (mulx/adcx) for the 256-bit field
+# arithmetic — a large win for ECDSA. Fall back to portable codegen on
+# toolchains that reject the flag.
+if ! g++ -O3 -march=native -fPIC -shared -std=c++17 -pthread \
+    -o build/libconsensus_native.so consensus_native.cpp 2>/dev/null; then
+  g++ -O3 -fPIC -shared -std=c++17 -pthread \
+      -o build/libconsensus_native.so consensus_native.cpp
+fi
+# Stamp the builder's ISA fingerprint: the Python loader rebuilds when a
+# shared checkout lands on a host with different CPU extensions (a foreign
+# -march=native binary would SIGILL).
+grep -m1 '^flags' /proc/cpuinfo 2>/dev/null | sha256sum | cut -c1-16 \
+    > build/libconsensus_native.so.cputag 2>/dev/null || true
 echo "built build/libconsensus_native.so"

@@ -486,6 +486,33 @@ static U256 fp_inv(const U256& a) {
   return fp_pow(a, e);
 }
 
+// Square root mod p as a^((p+1)/4) (p ≡ 3 mod 4) on a dedicated addition
+// chain: the exponent's binary form is [223 ones][0][22 ones][0000][11][00],
+// so runs of ones are built by doubling-and-merging x_k = a^(2^k - 1) —
+// ~253 squarings + 13 multiplies vs the generic windowed pow's
+// ~256 sq + 62 mul. Callers verify y² == alpha afterwards, so a chain
+// defect fails closed instead of mis-recovering.
+static U256 fp_sqrt(const U256& a) {
+  auto sqn = [](U256 x, int n) {
+    for (int i = 0; i < n; i++) x = fp_sqr(x);
+    return x;
+  };
+  U256 x2 = fp_mul(fp_sqr(a), a);
+  U256 x3 = fp_mul(fp_sqr(x2), a);
+  U256 x6 = fp_mul(sqn(x3, 3), x3);
+  U256 x9 = fp_mul(sqn(x6, 3), x3);
+  U256 x11 = fp_mul(sqn(x9, 2), x2);
+  U256 x22 = fp_mul(sqn(x11, 11), x11);
+  U256 x44 = fp_mul(sqn(x22, 22), x22);
+  U256 x88 = fp_mul(sqn(x44, 44), x44);
+  U256 x176 = fp_mul(sqn(x88, 88), x88);
+  U256 x220 = fp_mul(sqn(x176, 44), x44);
+  U256 x223 = fp_mul(sqn(x220, 3), x3);
+  U256 r = fp_mul(sqn(x223, 23), x22);  // [223 ones][0][22 ones]
+  r = fp_mul(sqn(r, 6), x2);            // append 0000 then 11
+  return sqn(r, 2);                     // trailing 00
+}
+
 // Montgomery batch inversion: one fp_inv amortised over the whole array.
 // Zero entries are left untouched (callers use zero as an "absent" marker).
 static void fp_batch_inv(U256* vals, int n) {
@@ -817,6 +844,81 @@ static Point glv_mul(const Point& p, const U256& u) {
   return acc;
 }
 
+// ── Batched affine-GLV ladder ──────────────────────────────────────
+// The verify hot path amortises ONE field inversion across a whole
+// chunk's per-item wNAF tables (8 z's per item into a cross-item
+// Montgomery batch), so every ladder addition runs on the cheaper mixed
+// (affine-operand) formulas, and the φ-table is derived free from the
+// affine base table (φ(x, y) = (β·x, y); negation flips y only).
+struct GlvPrep {
+  int8_t naf1[260], naf2[260];
+  int len1, len2;
+  Point jtbl[8];       // jacobian odd multiples 1,3,...,15 of ±R
+  AffinePoint tbl[8];  // affine conversions (phase B)
+  U256 beta_x[8];      // φ-table x coordinates
+  bool flip2;          // second scalar's sign differs from the first's
+  bool glv;            // affine ladder prepared (else q computed eagerly)
+};
+
+// Phase A: split the scalar, build the jacobian odd-multiple table of
+// ±R, and export the 8 z coordinates for the cross-item batch inversion.
+static void glv_prep_phase(const U256& rx, const U256& ry, const U256& u2,
+                           GlvPrep& gp, U256* zs8) {
+  U256 k1, k2;
+  bool n1, n2;
+  glv_split(u2, k1, n1, k2, n2);
+  gp.len1 = build_wnaf5(k1, gp.naf1);
+  gp.len2 = build_wnaf5(k2, gp.naf2);
+  gp.flip2 = (n1 != n2);
+  Point p1 = {rx, ry, {{1, 0, 0, 0}}};
+  if (n1) p1 = pt_neg(p1);
+  gp.jtbl[0] = p1;
+  Point d1 = pt_double(p1);
+  for (int i = 1; i < 8; i++) gp.jtbl[i] = pt_add(gp.jtbl[i - 1], d1);
+  for (int i = 0; i < 8; i++) zs8[i] = gp.jtbl[i].z;
+}
+
+// Phase B: finish the affine conversion with the batch-inverted z's and
+// run the dual ladder on mixed additions.
+static Point glv_ladder_affine(GlvPrep& gp, const U256* zinv8) {
+  for (int i = 0; i < 8; i++) {
+    const Point& p = gp.jtbl[i];
+    AffinePoint& a = gp.tbl[i];
+    a.inf = pt_is_inf(p);
+    if (a.inf) {
+      gp.beta_x[i] = p.x;
+      continue;
+    }
+    U256 zi2 = fp_sqr(zinv8[i]);
+    a.x = fp_mul(p.x, zi2);
+    a.y = fp_mul(p.y, fp_mul(zi2, zinv8[i]));
+    gp.beta_x[i] = fp_mul(a.x, GLV_BETA);
+  }
+  Point acc = P_INF;
+  int len = gp.len1 > gp.len2 ? gp.len1 : gp.len2;
+  for (int i = len - 1; i >= 0; i--) {
+    acc = pt_double(acc);
+    if (i < gp.len1) {
+      int d = gp.naf1[i];
+      if (d) {
+        AffinePoint t = gp.tbl[((d < 0 ? -d : d) - 1) >> 1];
+        if (d < 0 && !t.inf) u256_sub(t.y, FP.m, t.y);
+        acc = pt_add_affine(acc, t);
+      }
+    }
+    if (i < gp.len2) {
+      int d = gp.naf2[i];
+      if (d) {
+        int idx = ((d < 0 ? -d : d) - 1) >> 1;
+        AffinePoint t = {gp.beta_x[idx], gp.tbl[idx].y, gp.tbl[idx].inf};
+        if (((d < 0) != gp.flip2) && !t.inf) u256_sub(t.y, FP.m, t.y);
+        acc = pt_add_affine(acc, t);
+      }
+    }
+  }
+  return acc;
+}
+
 // Projective equality: x1·z2² == x2·z1² and y1·z2³ == y2·z1³.
 static bool pt_equal(const Point& a, const Point& b) {
   if (pt_is_inf(a) || pt_is_inf(b)) return pt_is_inf(a) == pt_is_inf(b);
@@ -826,35 +928,38 @@ static bool pt_equal(const Point& a, const Point& b) {
   return u256_cmp(fp_mul(a.y, zb3), fp_mul(b.y, za3)) == 0;
 }
 
-// Fixed-base 4-bit window table for G: g_table[w][d-1] = (16^w * d) * G,
+// Fixed-base 8-bit window table for G: g_table[w][d-1] = (256^w * d) * G,
 // stored affine (one batch inversion at init) so g_mul runs on the cheaper
-// mixed addition. Callers enter through ctypes with the GIL released, so
-// initialisation must be race-free: std::call_once.
-static AffinePoint g_table[64][15];
+// mixed addition — 32 windows means ~32 mixed adds per fixed-base multiply
+// (the earlier 4-bit table paid ~64). ~590 KB of table, built once.
+// Callers enter through ctypes with the GIL released, so initialisation
+// must be race-free: std::call_once.
+static constexpr int GT_WINDOWS = 32;
+static constexpr int GT_ENTRIES = 255;
+static AffinePoint g_table[GT_WINDOWS][GT_ENTRIES];
 static std::once_flag g_table_once;
 
 static void build_g_table_impl() {
-  static Point jac[64][15];
+  std::vector<Point> jac((size_t)GT_WINDOWS * GT_ENTRIES);
   Point base = {GX, GY, {{1, 0, 0, 0}}};
-  for (int w = 0; w < 64; w++) {
+  for (int w = 0; w < GT_WINDOWS; w++) {
     Point acc = P_INF;
-    for (int d = 0; d < 15; d++) {
+    for (int d = 0; d < GT_ENTRIES; d++) {
       acc = pt_add(acc, base);
-      jac[w][d] = acc;
+      jac[(size_t)w * GT_ENTRIES + d] = acc;
     }
-    for (int b = 0; b < 4; b++) base = pt_double(base);
+    for (int b = 0; b < 8; b++) base = pt_double(base);
   }
-  std::vector<U256> zs(64 * 15);
-  for (int w = 0; w < 64; w++)
-    for (int d = 0; d < 15; d++) zs[w * 15 + d] = jac[w][d].z;
-  fp_batch_inv(zs.data(), 64 * 15);
-  for (int w = 0; w < 64; w++) {
-    for (int d = 0; d < 15; d++) {
-      const Point& p = jac[w][d];
+  std::vector<U256> zs((size_t)GT_WINDOWS * GT_ENTRIES);
+  for (size_t i = 0; i < zs.size(); i++) zs[i] = jac[i].z;
+  fp_batch_inv(zs.data(), (int)zs.size());
+  for (int w = 0; w < GT_WINDOWS; w++) {
+    for (int d = 0; d < GT_ENTRIES; d++) {
+      const Point& p = jac[(size_t)w * GT_ENTRIES + d];
       AffinePoint& a = g_table[w][d];
-      a.inf = pt_is_inf(p);  // never true for d*16^w*G, but stay defensive
+      a.inf = pt_is_inf(p);  // never true for d*256^w*G, but stay defensive
       if (a.inf) continue;
-      U256 zi = zs[w * 15 + d];
+      U256 zi = zs[(size_t)w * GT_ENTRIES + d];
       U256 zi2 = fp_sqr(zi);
       a.x = fp_mul(p.x, zi2);
       a.y = fp_mul(p.y, fp_mul(zi2, zi));
@@ -873,8 +978,8 @@ static void build_g_table() { std::call_once(g_table_once, build_g_table_impl); 
 static Point g_mul(const U256& scalar) {
   build_g_table();
   Point result = P_INF;
-  for (int w = 0; w < 64; w++) {
-    int digit = (scalar.v[w / 16] >> (4 * (w % 16))) & 0xF;
+  for (int w = 0; w < GT_WINDOWS; w++) {
+    int digit = (scalar.v[w / 8] >> (8 * (w % 8))) & 0xFF;
     if (digit) result = pt_add_affine(result, g_table[w][digit - 1]);
   }
   return result;
@@ -902,13 +1007,8 @@ static bool recover_r_point(const U256& r, int recid, U256& x_out,
   }
   // alpha = x^3 + 7 mod p
   U256 alpha = fp_add(fp_mul(fp_sqr(x), x), {{7, 0, 0, 0}});
-  // y = alpha^((p+1)/4): p ≡ 3 mod 4
-  U256 e = FP.m;
-  U256 one = {{1, 0, 0, 0}};
-  u256_add(e, e, one);
-  u256_shr1(e);
-  u256_shr1(e);
-  U256 y = fp_pow(alpha, e);
+  // y = alpha^((p+1)/4): p ≡ 3 mod 4 (dedicated chain; checked below)
+  U256 y = fp_sqrt(alpha);
   if (u256_cmp(fp_sqr(y), alpha) != 0) return false;
   if ((y.v[0] & 1) != (uint64_t)(recid & 1)) {
     U256 ny;
@@ -1139,14 +1239,18 @@ void hg_eth_verify_batch(const uint8_t* identities, const uint8_t* payloads,
                          int64_t count, uint8_t* results, int n_threads) {
   build_g_table();
   run_parallel(count, n_threads, 4, [&](int64_t lo, int64_t hi) {
-    // Chunked so the two Montgomery batch inversions (r⁻¹ mod n before the
-    // scalar multiplies, z⁻¹ mod p for the affine conversion) each amortise
+    // Chunked so the three Montgomery batch inversions (r⁻¹ mod n before
+    // the scalar multiplies, the per-item wNAF-table z's for the affine
+    // GLV ladder, and q's z for the final affine conversion) each amortise
     // one real inversion over up to 64 signatures.
     const int64_t CHUNK = 64;
     VerifyItem items[CHUNK];
     U256 rinvs[CHUNK];
+    U256 u1s[CHUNK];
     Point qs[CHUNK];
     U256 zs[CHUNK];
+    std::vector<GlvPrep> preps(CHUNK);
+    std::vector<U256> ztbl(CHUNK * 8);
     const U256 zero = {{0, 0, 0, 0}};
     for (int64_t base = lo; base < hi; base += CHUNK) {
       int64_t m = std::min(CHUNK, hi - base);
@@ -1161,9 +1265,32 @@ void hg_eth_verify_batch(const uint8_t* identities, const uint8_t* payloads,
       for (int64_t j = 0; j < m; j++) {
         int64_t i = base + j;
         zs[j] = zero;
+        preps[j].glv = false;
+        for (int t = 0; t < 8; t++) ztbl[8 * j + t] = zero;
         if (results[i] != 1) continue;
-        if (!recover_combine(items[j].rx, items[j].ry, items[j].s,
-                             items[j].z, rinvs[j], qs[j]))
+        const U256& z = items[j].z;
+        U256 u1 = u256_is_zero(z) ? z
+                                  : mod_mul(mod_sub(FN.m, z, FN), rinvs[j], FN);
+        U256 u2 = mod_mul(items[j].s, rinvs[j], FN);
+        u1s[j] = u1;
+        if (glv_ok && !u256_is_zero(u2)) {
+          preps[j].glv = true;
+          glv_prep_phase(items[j].rx, items[j].ry, u2, preps[j],
+                         &ztbl[8 * j]);
+        } else if (!recover_combine(items[j].rx, items[j].ry, items[j].s,
+                                    items[j].z, rinvs[j], qs[j])) {
+          results[i] = 254;
+        } else {
+          zs[j] = qs[j].z;
+        }
+      }
+      fp_batch_inv(ztbl.data(), (int)(8 * m));
+      for (int64_t j = 0; j < m; j++) {
+        int64_t i = base + j;
+        if (results[i] != 1 || !preps[j].glv) continue;
+        Point sr = glv_ladder_affine(preps[j], &ztbl[8 * j]);
+        qs[j] = pt_add(sr, g_mul(u1s[j]));
+        if (pt_is_inf(qs[j]))
           results[i] = 254;
         else
           zs[j] = qs[j].z;
