@@ -275,6 +275,100 @@ assert stats.total_sessions == P + 4, stats.__dict__
 assert stats.consensus_reached == P + 3, stats.__dict__  # all but failed fpid
 assert stats.failed_sessions == 1, stats.__dict__
 
+# ── Multi-scope columnar + spill-heavy population + fleet checkpoint ──
+# Exhaust the remaining device slots with filler sessions so the next 9
+# all HOST-SPILL: replicated on every process, votes applied fleet-wide,
+# events from process 0 only.
+drain_pids()  # flush leftovers (e.g. the sweep's owner-side event)
+fill_pids = [4500 + i for i in range(engine.pool().free_slots)]
+for pid in fill_pids:
+    engine.process_incoming_proposal("fill", proposal(pid, n=3), NOW)
+assert engine.pool().free_slots == 0
+mscopes = ["m0", "m1", "m2"]
+mpids = {s: [5000 + 100 * k + j for j in range(3)] for k, s in enumerate(mscopes)}
+for s in mscopes:
+    for pid in mpids[s]:
+        engine.process_incoming_proposal(s, proposal(pid, n=3), NOW)
+        assert engine.is_local(s, pid)  # replicated spill: local everywhere
+
+mv = [StubConsensusSigner(bytes([70 + i]) * 20) for i in range(2)]
+col_sidx, col_pids, col_gids, col_vals = [], [], [], []
+for k, s in enumerate(mscopes):
+    for pid in mpids[s]:
+        ferry = engine.get_proposal(s, pid)
+        for voter in mv:
+            v = build_vote(ferry, True, voter, NOW + 7)
+            ferry.votes.append(v)
+            col_sidx.append(k)
+            col_pids.append(pid)
+            col_gids.append(engine.voter_gid(v.vote_owner))
+            col_vals.append(True)
+st = engine.ingest_columnar_multi(
+    mscopes,
+    np.array(col_sidx, np.int64),
+    np.array(col_pids, np.int64),
+    np.array(col_gids, np.int64),
+    np.array(col_vals, bool),
+    NOW + 8,
+)
+assert (st == int(StatusCode.OK)).all(), st
+
+# Exact events: all 9 decisions, on process 0 ONLY (spill event ownership).
+m_events = sorted(drain_pids("ConsensusReached"))
+m_expected = sorted(p for s in mscopes for p in mpids[s]) if process_id == 0 else []
+assert m_events == m_expected, (m_events, m_expected)
+
+# Exact per-scope histograms on EVERY process (mirror of the dryrun's
+# exact-count discipline, at 2 real processes).
+for s in mscopes:
+    mstats = engine.get_scope_stats(s)
+    assert (
+        mstats.total_sessions, mstats.active_sessions,
+        mstats.consensus_reached, mstats.failed_sessions,
+    ) == (3, 0, 3, 0), mstats.__dict__
+    for pid in mpids[s]:
+        assert engine.get_consensus_result(s, pid) is True
+
+# Fleet checkpoint: each process persists the replicated scopes, the fleet
+# proves the stored state is byte-identical everywhere, and a fresh engine
+# restores it with identical histograms and tallies.
+import hashlib
+from hashgraph_tpu import InMemoryConsensusStorage
+from hashgraph_tpu.engine.session_sync import state_code_of
+
+storage = InMemoryConsensusStorage()
+for s in mscopes:
+    for pid in mpids[s]:
+        storage.save_session(s, engine.export_session(s, pid))
+digest = hashlib.sha256()
+for s in mscopes:
+    for sess in sorted(
+        storage.list_scope_sessions(s), key=lambda x: x.proposal.proposal_id
+    ):
+        digest.update(sess.proposal.encode())
+        digest.update(bytes([state_code_of(sess.state)]))
+        digest.update(repr(sorted(sess.tallies.items())).encode())
+agreed_digest = multihost_utils.process_allgather(
+    np.frombuffer(digest.digest()[:8], np.int64)
+)
+assert int(np.min(agreed_digest)) == int(np.max(agreed_digest)), "fleet desync"
+
+restored = TpuConsensusEngine(
+    StubConsensusSigner(b"fleet-signer-00000000"[:20]),
+    capacity=16, voter_capacity=8, max_sessions_per_scope=64,
+)
+n_loaded = restored.load_from_storage(storage)
+assert n_loaded == 9, n_loaded
+for s in mscopes:
+    rstats = restored.get_scope_stats(s)
+    assert (
+        rstats.total_sessions, rstats.active_sessions,
+        rstats.consensus_reached, rstats.failed_sessions,
+    ) == (3, 0, 3, 0), rstats.__dict__
+    for pid in mpids[s]:
+        assert restored.get_consensus_result(s, pid) is True
+        assert len(restored.export_session(s, pid).tallies) == 2
+
 owned = sorted(local_pids + [p for p in (cpid, tpid, fpid, spid) if engine.is_local("s", p)])
 print(f"ENGINE_MULTIHOST_OK p{process_id} owned={owned}")
 """
