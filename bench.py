@@ -702,7 +702,7 @@ def run_config2(voters: int = 1024, repeats: int = 9) -> dict:
     # spread is visible. On a tunneled TPU the p50 above is ~one link RTT
     # that directly-attached hardware does not pay; BASELINE's finality
     # metric wants the device-side figure.
-    def chain_wall(n_chains: int) -> float:
+    def chain_wall(n_chains: int, fresh: bool) -> float:
         slot_ids = pool.allocate_batch(
             keys=[("lat", i) for i in range(n_chains)],
             n=np.full(n_chains, voters),
@@ -716,12 +716,29 @@ def run_config2(voters: int = 1024, repeats: int = 9) -> dict:
         lanes_l = np.arange(cap, dtype=np.int32)
         values_l = np.ones(cap, bool)
         t0 = time.perf_counter()
-        pendings = [
-            pool.ingest_async(
-                np.full(cap, s, np.int64), lanes_l, values_l, now
-            )
-            for s in slot_ids
-        ]
+        if fresh:
+            # The closed-form kernel the engine fast path dispatches:
+            # whole chains, no sequential scan.
+            pendings = [
+                pool.ingest_async_grouped(
+                    np.array([s], np.int64),
+                    np.zeros(cap, np.int64),
+                    np.arange(cap, dtype=np.int64),
+                    cap,
+                    lanes_l,
+                    values_l,
+                    now,
+                    fresh=True,
+                )
+                for s in slot_ids
+            ]
+        else:
+            pendings = [
+                pool.ingest_async(
+                    np.full(cap, s, np.int64), lanes_l, values_l, now
+                )
+                for s in slot_ids
+            ]
         results = pool.complete_all(pendings)
         wall = time.perf_counter() - t0
         for _, transitions in results:
@@ -730,15 +747,18 @@ def run_config2(voters: int = 1024, repeats: int = 9) -> dict:
         return wall
 
     K = 32
-    chain_wall(K)  # warmup (allocate-bucket + stack-kernel compiles)
-    samples_ms = []
-    w1s = []
-    for _ in range(3):
-        w1 = chain_wall(1)
-        wk = chain_wall(K)
-        w1s.append(w1)
-        samples_ms.append(max(wk - w1, 0.0) / (K - 1) * 1000)
-    device_ms = sorted(samples_ms)[1]
+
+    def slope(fresh: bool) -> tuple[float, list[float]]:
+        chain_wall(K, fresh)  # warmup (bucket + stack-kernel compiles)
+        samples = []
+        for _ in range(3):
+            w1 = chain_wall(1, fresh)
+            wk = chain_wall(K, fresh)
+            samples.append(max(wk - w1, 0.0) / (K - 1) * 1000)
+        return sorted(samples)[1], samples
+
+    device_ms, samples_ms = slope(fresh=False)
+    fresh_ms, fresh_samples = slope(fresh=True)
     return {
         "metric": "p2p_finality_latency_p50",
         "value": round(p50 * 1000, 3),
@@ -750,9 +770,10 @@ def run_config2(voters: int = 1024, repeats: int = 9) -> dict:
             "latencies_ms": [round(l * 1000, 2) for l in latencies],
             "device_exec_ms_per_decision": round(device_ms, 3),
             "device_exec_samples_ms": [round(s, 3) for s in samples_ms],
-            # Measured separately from the p50 loop above; on this rig a
-            # single decision's wall clock is ~one link round-trip.
-            "single_chain_wall_ms": round(sorted(w1s)[1] * 1000, 3),
+            # Closed-form (scan-free) kernel — the engine fast path's
+            # dispatch for fresh chains.
+            "device_exec_fresh_ms_per_decision": round(fresh_ms, 3),
+            "device_exec_fresh_samples_ms": [round(s, 3) for s in fresh_samples],
             "platform": jax.devices()[0].platform,
         },
     }

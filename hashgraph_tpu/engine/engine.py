@@ -1324,6 +1324,7 @@ class TpuConsensusEngine(Generic[Scope]):
         lanes_sorted = np.empty(0, np.int32)
         vals_sorted = np.empty(0, bool)
         uniq = starts_idx = grp_sorted = col_sorted = counts = None
+        fast_lanes = False
         if dev_rows.size:
             dslots = slots[dev_rows]
             order = np.argsort(dslots, kind="stable")
@@ -1333,6 +1334,7 @@ class TpuConsensusEngine(Generic[Scope]):
             lanes_sorted = self._pool.fresh_lanes_grouped(
                 s_sorted, gid_idx_sorted, col_sorted, uniq, counts
             )
+            fast_lanes = lanes_sorted is not None
             if lanes_sorted is None:
                 # General path (pre-voted slots or an in-batch duplicate
                 # voter); assume_live: the gids_live gate above ran.
@@ -1354,14 +1356,38 @@ class TpuConsensusEngine(Generic[Scope]):
                     )
             vals_sorted = values[dev_rows][order]
 
-        # Bounded-depth pipelining, sort-free: in the sorted domain each
-        # slot's items are contiguous and arrival-ordered, so segment k
-        # (votes [k*D, (k+1)*D) of every slot) is a repeat/arange gather —
-        # no per-segment re-sort. Segmenting keeps every dispatch's scan
-        # depth <= max_depth and lets the async queue overlap transfers
-        # with device compute.
-        segs: list[tuple] = []  # (uniq_k, rows_k, cols_k, depth_k, idx_k)
-        if len(order):
+        # Dispatch plan. Preferred: ONE closed-form (scan-free) dispatch for
+        # the whole batch — valid exactly when the fast lane path ran (fresh
+        # slots, no duplicate voters) and every touched slot is still ACTIVE
+        # (rare non-ACTIVE fresh slots: empty sessions decided by timeout).
+        # The grid is [S, depth]-padded, so a cell-budget guard falls back
+        # to the segmented scan when padding would blow up (one slot with a
+        # huge chain amid many shallow ones). Fallback: bounded-depth scan
+        # segmentation — in the sorted domain each slot's items are
+        # contiguous and arrival-ordered, so segment k (votes
+        # [k*D, (k+1)*D) of every slot) is a repeat/arange gather with no
+        # per-segment re-sort.
+        segs: list[tuple] = []  # (uniq_k, rows_k, cols_k, depth_k, idx_k, fresh)
+        use_fresh = (
+            fast_lanes
+            and len(order) > 0
+            and not self._multihost
+            and self._pool.fresh_ingest_viable(
+                uniq, int(counts.max()), len(order)
+            )
+        )
+        if use_fresh:
+            segs.append(
+                (
+                    uniq,
+                    grp_sorted,
+                    col_sorted,
+                    int(counts.max()),
+                    np.arange(len(order), dtype=np.int64),
+                    True,
+                )
+            )
+        elif len(order):
             depth = int(counts.max())
             if depth > max_depth:
                 d = max_depth
@@ -1383,7 +1409,7 @@ class TpuConsensusEngine(Generic[Scope]):
                     # segment would give its output a different shape,
                     # splitting complete_all's single stacked readback into
                     # two transfers. Pad columns are valid=0, inert.
-                    segs.append((uniq[sel], rows_k, local, d, idx_k))
+                    segs.append((uniq[sel], rows_k, local, d, idx_k, False))
             else:
                 segs.append(
                     (
@@ -1392,6 +1418,7 @@ class TpuConsensusEngine(Generic[Scope]):
                         col_sorted,
                         depth,
                         np.arange(len(order), dtype=np.int64),
+                        False,
                     )
                 )
         if self._multihost:
@@ -1404,13 +1431,13 @@ class TpuConsensusEngine(Generic[Scope]):
             )
             empty = np.empty(0, np.int64)
             for _ in range(int(np.max(agreed)) - len(segs)):
-                segs.append((empty, empty, empty, 0, empty))
+                segs.append((empty, empty, empty, 0, empty, False))
         if not segs:
             return statuses
 
         pendings = []
         orig_of = []  # statuses rows per pending, in dispatch item order
-        for uniq_k, rows_k, cols_k, depth_k, idx_k in segs:
+        for uniq_k, rows_k, cols_k, depth_k, idx_k, fresh_k in segs:
             pendings.append(
                 self._pool.ingest_async_grouped(
                     uniq_k,
@@ -1420,6 +1447,7 @@ class TpuConsensusEngine(Generic[Scope]):
                     lanes_sorted[idx_k],
                     vals_sorted[idx_k],
                     now,
+                    fresh=fresh_k,
                 )
             )
             orig_of.append(dev_rows[order[idx_k]])

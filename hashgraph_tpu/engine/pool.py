@@ -40,7 +40,13 @@ from ..ops.decide import (
     STATE_FREE,
     timeout_kernel,
 )
-from ..ops.ingest import group_batch, ingest_kernel, pack_grid, pack_slots
+from ..ops.ingest import (
+    fresh_ingest_kernel,
+    group_batch,
+    ingest_kernel,
+    pack_grid,
+    pack_slots,
+)
 
 __all__ = ["ProposalPool", "SlotMeta", "PoolFullError"]
 
@@ -214,6 +220,10 @@ class ProposalPool:
                     interpret=jax.default_backend() != "tpu",
                 )
             )
+            # Keep the pallas A/B meaningful: with the opt-in kernel active
+            # the engine must not silently route its dominant fast path to
+            # the XLA closed-form kernel instead.
+            self.supports_fresh_ingest = False
         self._init_device_arrays()
 
         # Host mirrors / bookkeeping.
@@ -728,6 +738,32 @@ class ProposalPool:
             uniq, row, col, depth, lanes, values, now
         )
 
+    # True where ingest_async_grouped(fresh=True) routes to the closed-form
+    # kernel; sharded/multi-host pools override their dispatch hooks but not
+    # the fresh one, so they advertise False until they grow one.
+    supports_fresh_ingest = True
+
+    def fresh_ingest_viable(
+        self, uniq: np.ndarray, depth: int, n_items: int
+    ) -> bool:
+        """Whether a slot-grouped batch may take the closed-form (scan-free)
+        ingest dispatch. Owns the invariants next to the kernel they guard:
+        the pool supports it, every touched slot is still ACTIVE on the
+        host state mirror (rare non-ACTIVE fresh slots: empty sessions
+        decided by timeout), and the [S, depth]-padded grid stays within a
+        cell budget — padding would blow up when one huge chain sits amid
+        many shallow ones, at which point the segmented scan wins. The
+        caller must separately establish freshness + no duplicate voters
+        (fresh_lanes_grouped does both)."""
+        if not self.supports_fresh_ingest:
+            return False
+        cells = _bucket(len(uniq)) * _bucket(depth, floor=1)
+        return (
+            cells <= max(8 * n_items, 65_536)
+            and cells <= 33_554_432
+            and bool((self._state_host[uniq] == STATE_ACTIVE).all())
+        )
+
     def ingest_async_grouped(
         self,
         uniq: np.ndarray,
@@ -737,13 +773,19 @@ class ProposalPool:
         lanes: np.ndarray,
         values: np.ndarray,
         now: int,
+        fresh: bool = False,
     ) -> PendingIngest:
         """Pre-grouped :meth:`ingest_async`: the caller already grouped the
         batch by slot (``uniq[S]`` touched slots, per-item grid coordinates
         ``row``/``col``, ``depth`` = max votes per slot). The engine's
         columnar path computes the grouping once for a whole multi-dispatch
         batch and slices it per segment — skipping one O(B log B) sort per
-        dispatch that :func:`group_batch` would redo."""
+        dispatch that :func:`group_batch` would redo.
+
+        ``fresh=True`` dispatches the closed-form kernel (no sequential
+        scan) — ONLY valid when every touched slot is freshly ACTIVE with
+        zero tallies and the batch has no repeated (slot, voter) pair; the
+        engine's fast path establishes exactly that."""
         s_count = len(uniq)
         depth = max(int(depth), 1)
         voter_grid = np.zeros((s_count, depth), np.int32)
@@ -754,7 +796,10 @@ class ProposalPool:
         grid = pack_grid(voter_grid, valbit & 1, valbit >> 1)
 
         expired = self._expiry_host[uniq] <= now
-        out, row_select = self._dispatch_ingest(
+        dispatch = (
+            self._dispatch_ingest_fresh if fresh else self._dispatch_ingest
+        )
+        out, row_select = dispatch(
             pack_slots(uniq.astype(np.int32), expired), grid
         )
         pending = PendingIngest(
@@ -947,6 +992,35 @@ class ProposalPool:
             self._vote_val,
             out,
         ) = self._ingest_kernel(
+            self._state,
+            self._yes,
+            self._tot,
+            self._vote_mask,
+            self._vote_val,
+            self._n,
+            self._req,
+            self._cap,
+            self._gossip,
+            self._liveness,
+            jnp.asarray(_pad_slot_ids(slot_pack, bucket_s, self.capacity)),
+            jnp.asarray(_pad2(grid_pack, bucket_s, bucket_l, np.int32)),
+        )
+        return out, np.arange(s_count)
+
+    def _dispatch_ingest_fresh(self, slot_pack, grid_pack):
+        """Closed-form (scan-free) ingest dispatch for fresh-slot batches —
+        same transfer contract as :meth:`_dispatch_ingest`."""
+        s_count, depth = grid_pack.shape
+        bucket_s = _bucket(s_count)
+        bucket_l = _bucket(depth, floor=1)
+        (
+            self._state,
+            self._yes,
+            self._tot,
+            self._vote_mask,
+            self._vote_val,
+            out,
+        ) = fresh_ingest_kernel(
             self._state,
             self._yes,
             self._tot,

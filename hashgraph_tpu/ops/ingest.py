@@ -236,3 +236,155 @@ def ingest_body(
 # Jitted single-device entry point; the raw body is reused inside shard_map
 # blocks by the multi-device pool (hashgraph_tpu.parallel).
 ingest_kernel = partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))(ingest_body)
+
+
+def fresh_ingest_body(
+    state,
+    yes,
+    tot,
+    vote_mask,
+    vote_val,
+    n,
+    req,
+    cap,
+    gossipsub,
+    liveness,
+    slot_pack,  # int32[S] packed slot ids + expired flags
+    grid_pack,  # int32[S, L] packed voter/value/valid cells
+):
+    """Closed-form ingest for FRESH slots: the whole per-slot vote chain in
+    one dispatch with NO sequential scan.
+
+    The serial scan in :func:`ingest_body` exists because a vote's fate
+    depends on the running state. For a batch the engine has already
+    resolved on its fast path — every touched slot freshly ACTIVE with zero
+    prior tallies, and no repeated (slot, voter) pair — that dependency has
+    a closed form: every valid vote before the terminal event is accepted,
+    so the running tallies are prefix sums (XLA's log-depth parallel
+    cumsum, not an L-step scan), the round-cap violation index and the
+    decision index are first-true reductions over elementwise
+    :func:`decide_kernel`, and statuses fall out of index-vs-terminal
+    comparisons. Semantics are bit-identical to replaying the scan on a
+    fresh slot (randomized parity-tested); per-slot wall clock drops from
+    O(depth) scan steps to O(log depth), which is the difference between
+    ~16 ms and ~1 ms for a 683-deep P2P quorum chain.
+
+    PRECONDITIONS (engine-enforced): touched slots are ACTIVE with
+    tot == yes == 0 and cleared mask/val rows; the batch has no duplicate
+    (slot, voter) pair. Pad rows/cells follow the scan kernel's contract.
+    Returns the same (updated arrays..., out int8[S, L+1]) shape.
+    """
+    s_count, depth = grid_pack.shape
+
+    slot_ids = slot_pack & _SLOT_MASK
+    expired = ((slot_pack >> _EXPIRED_BIT) & 1).astype(bool)
+    voter_grid = grid_pack & _LANE_MASK
+    val_grid = ((grid_pack >> _VAL_BIT) & 1).astype(bool)
+    valid = ((grid_pack >> _VALID_BIT) & 1).astype(bool)
+
+    gather = lambda arr: jnp.take(arr, slot_ids, axis=0, mode="clip")
+    row_n = gather(n)[:, None]
+    row_req = gather(req)[:, None]
+    row_cap = gather(cap)[:, None]
+    row_gossip = gather(gossipsub)[:, None]
+    row_live = gather(liveness)[:, None]
+
+    live = valid & ~expired[:, None]
+    T = jnp.cumsum(live.astype(jnp.int32), axis=1)
+    Y = jnp.cumsum((live & val_grid).astype(jnp.int32), axis=1)
+
+    # Round-cap check per vote, pre-accept (reference: src/session.rs:306-344):
+    # gossipsub projects round 2; P2P projects accepted-before + 1 == T_i for
+    # a valid vote on a fresh slot.
+    projected = jnp.where(row_gossip, 2, T)
+    exceeded = live & (projected > row_cap)
+    decided_i, result_i = decide_kernel(Y, T, row_n, row_req, row_live, False)
+    dec = live & decided_i
+
+    idxs = jnp.arange(depth, dtype=jnp.int32)[None, :]
+    c_has = dec.any(axis=1)
+    c = jnp.where(c_has, jnp.argmax(dec, axis=1).astype(jnp.int32), depth)
+    f_has = exceeded.any(axis=1)
+    f = jnp.where(f_has, jnp.argmax(exceeded, axis=1).astype(jnp.int32), depth)
+    # A vote that violates the cap is rejected before it could decide, so
+    # the cap-fail terminal wins ties.
+    dec_term = c < f
+    fail_term = f_has & ~dec_term
+    t_idx = jnp.where(dec_term, c, f)[:, None]
+
+    # Statuses by region relative to the terminal index (the innermost
+    # else-branch is the post-terminal region; with no terminal, t == depth
+    # and every cell is "pre").
+    pre = idxs < t_idx
+    at = idxs == t_idx
+    status = jnp.where(
+        pre,
+        _OK,
+        jnp.where(
+            at,
+            jnp.where(dec_term[:, None], _OK, _MAX_ROUNDS_EXCEEDED),
+            jnp.where(
+                dec_term[:, None], _ALREADY_REACHED, _SESSION_NOT_ACTIVE
+            ),
+        ),
+    )
+    status = jnp.where(expired[:, None], _PROPOSAL_EXPIRED, status)
+    status = jnp.where(valid, status, PAD_STATUS).astype(jnp.int32)
+
+    # Accepted set: valid live votes up to the terminal (inclusive for a
+    # decision — the deciding vote is accepted; exclusive for a cap fail).
+    acc = live & (pre | (at & dec_term[:, None]))
+
+    # Final per-row tallies/state.
+    take_at = lambda M, i: jnp.take_along_axis(M, i[:, None], axis=1)[:, 0]
+    last_T = T[:, -1] if depth else jnp.zeros(s_count, jnp.int32)
+    last_Y = Y[:, -1] if depth else jnp.zeros(s_count, jnp.int32)
+    cc = jnp.minimum(c, depth - 1)
+    ff = jnp.minimum(f, depth - 1)
+    tot_new = jnp.where(
+        dec_term,
+        take_at(T, cc),
+        jnp.where(fail_term, take_at(T, ff) - 1, last_T),
+    )
+    yes_new = jnp.where(
+        dec_term,
+        take_at(Y, cc),
+        jnp.where(
+            fail_term,
+            take_at(Y, ff) - (take_at(val_grid, ff) & take_at(live, ff)),
+            last_Y,
+        ),
+    )
+    result_c = take_at(result_i, cc)
+    prev_state = gather(state)
+    row_state = jnp.where(
+        dec_term,
+        jnp.where(result_c, STATE_REACHED_YES, STATE_REACHED_NO),
+        jnp.where(fail_term, STATE_FAILED, prev_state),
+    ).astype(prev_state.dtype)
+
+    scatter = lambda arr, rows_val: arr.at[slot_ids].set(rows_val, mode="drop")
+    state = scatter(state, row_state)
+    yes = scatter(yes, yes_new.astype(yes.dtype))
+    tot = scatter(tot, tot_new.astype(tot.dtype))
+    rows_flat = jnp.repeat(slot_ids, depth)
+    lanes_flat = voter_grid.reshape(-1)
+    # Fresh rows start all-False, and each (slot, lane) cell is touched at
+    # most once (no duplicate voters on this path), so scatter-max writes
+    # exactly the accepted cells.
+    vote_mask = vote_mask.at[rows_flat, lanes_flat].max(
+        acc.reshape(-1), mode="drop"
+    )
+    vote_val = vote_val.at[rows_flat, lanes_flat].max(
+        (acc & val_grid).reshape(-1), mode="drop"
+    )
+
+    out = jnp.concatenate(
+        [status, row_state[:, None].astype(jnp.int32)], axis=1
+    ).astype(jnp.int8)
+    return state, yes, tot, vote_mask, vote_val, out
+
+
+fresh_ingest_kernel = partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))(
+    fresh_ingest_body
+)

@@ -72,6 +72,10 @@ class ShardedPool(ProposalPool):
     ``_dispatch_*`` device hooks are replaced with shard_map versions.
     """
 
+    # No shard_map version of the closed-form fresh kernel yet: the engine
+    # falls back to the scan dispatch path on sharded pools.
+    supports_fresh_ingest = False
+
     def __init__(
         self,
         capacity_per_device: int,

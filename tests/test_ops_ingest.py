@@ -115,7 +115,7 @@ def make_pool(configs):
     )
 
 
-def run_ingest(pool, slots, voters, vals, now):
+def run_ingest(pool, slots, voters, vals, now, kernel=None):
     """Group the flat batch, run the kernel, return per-vote statuses in
     batch order plus updated numpy pool arrays."""
     slots = np.asarray(slots, np.int64)
@@ -129,7 +129,7 @@ def run_ingest(pool, slots, voters, vals, now):
     valid_grid[row, col] = True
     expired = (expiry_of(pool, uniq) <= now)
 
-    out = ingest_kernel(
+    out = (kernel or ingest_kernel)(
         jnp.asarray(pool["state"]),
         jnp.asarray(pool["yes"]),
         jnp.asarray(pool["tot"]),
@@ -248,6 +248,82 @@ class TestIngestParity:
             trace.append((slot, voter, val))
 
         self._compare(pool, sessions, trace, now=NOW + 6)
+
+    def test_fresh_kernel_cases(self):
+        """Targeted fresh-kernel vs scan-kernel parity: the closed-form
+        kernel must be bit-identical on its precondition domain (fresh
+        ACTIVE slots, no duplicate voters): mid-batch decide cut, P2P
+        round-cap fail, gossip cap, expired, no-terminal."""
+        cases = [
+            # (configs, trace)
+            (
+                [(3, "gossipsub", True, 2 / 3, 1000)],
+                [(0, 0, True), (0, 1, True), (0, 2, True)],  # decide cut
+            ),
+            (
+                [(4, "p2p", False, 2 / 3, 1000)],
+                [(0, 0, True), (0, 1, False), (0, 2, True), (0, 3, True), (0, 4, True)],
+            ),  # cap fail mid-batch then SESSION_NOT_ACTIVE
+            (
+                [(3, "gossipsub", True, 2 / 3, 10)],
+                [(0, 0, True), (0, 1, False)],  # expired
+            ),
+            (
+                [(8, "p2p", True, 0.9, 1000)],
+                [(0, 0, True), (0, 1, False), (0, 2, True)],  # no terminal
+            ),
+            (
+                [(6, "p2p", False, 1.0, 1000), (2, "gossipsub", True, 2 / 3, 1000)],
+                [(0, 0, True), (1, 0, True), (0, 1, True), (1, 1, False),
+                 (0, 2, False), (0, 3, True), (0, 4, True), (0, 5, True)],
+            ),  # interleaved slots, unanimity n=2, threshold 1.0
+        ]
+        for configs, trace in cases:
+            self._compare_fresh(configs, trace, now=NOW + 20)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fresh_kernel_randomized_parity(self, seed):
+        """Randomized fresh traces (unique voters per slot — the fast-path
+        precondition): statuses AND final pool arrays must match the scan
+        kernel exactly."""
+        rng = np.random.default_rng(1000 + seed)
+        configs = []
+        for _ in range(10):
+            n = int(rng.integers(1, 13))
+            mode = "gossipsub" if rng.random() < 0.5 else "p2p"
+            live = bool(rng.random() < 0.5)
+            threshold = float(rng.choice([2 / 3, 0.5, 0.9, 1.0]))
+            exp_off = int(rng.choice([5, 1000]))
+            configs.append((n, mode, live, threshold, exp_off))
+        trace = []
+        for slot in range(len(configs)):
+            k = int(rng.integers(0, V_CAP + 1))
+            voters = rng.permutation(V_CAP)[:k]  # unique per slot
+            for v in voters:
+                trace.append((slot, int(v), bool(rng.random() < 0.5)))
+        rng.shuffle(trace)
+        if not trace:
+            trace = [(0, 0, True)]
+        self._compare_fresh(configs, trace, now=NOW + 6)
+
+    def _compare_fresh(self, configs, trace, now):
+        from hashgraph_tpu.ops.ingest import fresh_ingest_kernel
+
+        pool_scan, _ = make_pool(configs)
+        pool_fresh, _ = make_pool(configs)
+        slots = np.array([t[0] for t in trace])
+        voters = np.array([t[1] for t in trace], np.int32)
+        vals = np.array([t[2] for t in trace], bool)
+        st_scan = run_ingest(pool_scan, slots, voters, vals, now)
+        st_fresh = run_ingest(
+            pool_fresh, slots, voters, vals, now, kernel=fresh_ingest_kernel
+        )
+        assert st_scan.tolist() == st_fresh.tolist(), (
+            [StatusCode(s).name for s in st_scan],
+            [StatusCode(s).name for s in st_fresh],
+        )
+        for key in ("state", "yes", "tot", "vote_mask", "vote_val"):
+            assert (pool_scan[key] == pool_fresh[key]).all(), key
 
     def test_pad_rows_cannot_corrupt_pool(self):
         pool, sessions = make_pool([(3, "gossipsub", True, 2 / 3, 1000)])
