@@ -1377,6 +1377,7 @@ class TpuConsensusEngine(Generic[Scope]):
             )
         )
         if use_fresh:
+            self.tracer.count("engine.fresh_dispatches")
             segs.append(
                 (
                     uniq,

@@ -739,8 +739,9 @@ class ProposalPool:
         )
 
     # True where ingest_async_grouped(fresh=True) routes to the closed-form
-    # kernel; sharded/multi-host pools override their dispatch hooks but not
-    # the fresh one, so they advertise False until they grow one.
+    # kernel (single-device and sharded pools). MultiHostPool advertises
+    # False (no fleet shape agreement for the fresh dispatch yet), as does
+    # the opt-in pallas configuration (to keep its A/B meaningful).
     supports_fresh_ingest = True
 
     def fresh_ingest_viable(

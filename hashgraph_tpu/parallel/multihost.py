@@ -120,6 +120,11 @@ class MultiHostPool(ShardedPool):
     - per-dispatch grid shapes are agreed via one small allgather.
     """
 
+    # The fleet agrees on dispatch shapes per call (allgather in
+    # _dispatch_ingest); the inherited single-process fresh dispatch has no
+    # such agreement, so the closed-form path stays off until it grows one.
+    supports_fresh_ingest = False
+
     def __init__(self, capacity_per_device, voter_capacity, mesh=None):
         mesh = mesh if mesh is not None else distributed_consensus_mesh()
         # Span first: _init_device_arrays (called from the base ctor) needs

@@ -39,7 +39,12 @@ from ..ops.decide import (
     STATE_REACHED_YES,
     timeout_body,
 )
-from ..ops.ingest import ingest_body, pack_slots, unpack_slots
+from ..ops.ingest import (
+    fresh_ingest_body,
+    ingest_body,
+    pack_slots,
+    unpack_slots,
+)
 from .mesh import PROPOSAL_AXIS, consensus_mesh
 from ..engine.pool import (
     ProposalPool,
@@ -72,9 +77,6 @@ class ShardedPool(ProposalPool):
     ``_dispatch_*`` device hooks are replaced with shard_map versions.
     """
 
-    # No shard_map version of the closed-form fresh kernel yet: the engine
-    # falls back to the scan dispatch path on sharded pools.
-    supports_fresh_ingest = False
 
     def __init__(
         self,
@@ -147,6 +149,16 @@ class ShardedPool(ProposalPool):
         self._sharded_ingest = jax.jit(
             sm(
                 ingest_body,
+                in_specs=(v1, v1, v1, v2, v2, v1, v1, v1, v1, v1, v1, v2),
+                out_specs=(v1, v1, v1, v2, v2, v2),
+            ),
+            donate_argnums=(0, 1, 2, 3, 4),
+        )
+        # Closed-form (scan-free) fresh ingest: pure per-shard elementwise
+        # + cumsum work, zero collectives — shards exactly like the scan.
+        self._sharded_fresh_ingest = jax.jit(
+            sm(
+                fresh_ingest_body,
                 in_specs=(v1, v1, v1, v2, v2, v1, v1, v1, v1, v1, v1, v2),
                 out_specs=(v1, v1, v1, v2, v2, v2),
             ),
@@ -268,6 +280,11 @@ class ShardedPool(ProposalPool):
     def _dispatch_ingest(self, slot_pack, grid_pack):
         """Route the packed batch to owning devices; non-blocking. Returns
         (device out [D*B, L+1], row indexer recovering the S input rows)."""
+        return self._routed_ingest(slot_pack, grid_pack, self._sharded_ingest)
+
+    def _routed_ingest(self, slot_pack, grid_pack, kernel):
+        """Shared routing/repack body for the scan and closed-form ingest
+        dispatches — one place owns the pad-sentinel/bucket contract."""
         s_count, depth = grid_pack.shape
         bucket_l = _bucket(depth, floor=1)
         slots_g, expired = unpack_slots(slot_pack)
@@ -284,7 +301,7 @@ class ShardedPool(ProposalPool):
         (
             self._state, self._yes, self._tot, self._vote_mask,
             self._vote_val, out,
-        ) = self._sharded_ingest(
+        ) = kernel(
             self._state, self._yes, self._tot, self._vote_mask,
             self._vote_val, self._n, self._req, self._cap,
             self._gossip, self._liveness,
@@ -292,6 +309,13 @@ class ShardedPool(ProposalPool):
             self._put_batch(grid_g),
         )
         return out, rows
+
+    def _dispatch_ingest_fresh(self, slot_pack, grid_pack):
+        """Sharded closed-form ingest; same routing contract as
+        :meth:`_dispatch_ingest`."""
+        return self._routed_ingest(
+            slot_pack, grid_pack, self._sharded_fresh_ingest
+        )
 
     def _dispatch_timeout(self, slots) -> np.ndarray:
         slot_grid, _, rows, _ = self._route(slots.astype(np.int64), [])

@@ -890,6 +890,41 @@ class TestWireRetention:
         assert [v.encode() for v in exported.votes] == encoded
 
 
+class TestFreshDispatchRouting:
+    def test_fresh_batch_takes_closed_form_dispatch(self):
+        """Regression guard: the common columnar shape (fresh slots, unique
+        voters) must route through the closed-form kernel — a silent fall
+        back to the segmented scan would be a large perf regression that no
+        correctness test would catch."""
+        from hashgraph_tpu.tracing import Tracer
+
+        engine = make_engine(capacity=32, voter_capacity=8)
+        engine.tracer = Tracer(enabled=True)
+        proposals = engine.create_proposals("s", [request(n=6)] * 4, NOW)
+        gids = np.array(
+            [engine.voter_gid(bytes([i]) * 4) for i in range(1, 5)], np.int64
+        )
+        pids = np.repeat(
+            np.array([p.proposal_id for p in proposals], np.int64), 4
+        )
+        statuses = engine.ingest_columnar(
+            "s", pids, np.tile(gids, 4), np.ones(16, bool), NOW + 1
+        )
+        assert (statuses == int(StatusCode.OK)).all()
+        assert engine.tracer.counters().get("engine.fresh_dispatches") == 1
+
+        # Second batch on the SAME (now non-fresh) slots: falls back to the
+        # general path, statuses still exact (dups rejected).
+        statuses = engine.ingest_columnar(
+            "s", pids, np.tile(gids, 4), np.ones(16, bool), NOW + 1
+        )
+        assert engine.tracer.counters().get("engine.fresh_dispatches") == 1
+        assert (
+            (statuses == int(StatusCode.DUPLICATE_VOTE))
+            | (statuses == int(StatusCode.ALREADY_REACHED))
+        ).all()
+
+
 class TestLaneBatchResolution:
     def test_mixed_existing_and_new(self):
         from hashgraph_tpu.engine import ProposalPool

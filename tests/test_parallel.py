@@ -120,6 +120,32 @@ class TestShardedEngine:
         for i, pid in enumerate(pids):
             assert engine.get_consensus_result(f"scope{i}", pid) is True
 
+    def test_columnar_fresh_dispatch_on_mesh(self, mesh):
+        """The closed-form (scan-free) kernel also serves the sharded pool:
+        one columnar batch over fresh sessions spanning all 8 devices takes
+        the fresh dispatch (tracer-asserted) and decides every session."""
+        from hashgraph_tpu.tracing import Tracer
+
+        engine = make_sharded_engine(
+            mesh, per_device=4, max_sessions_per_scope=32
+        )
+        engine.tracer = Tracer(enabled=True)
+        # n=4 (quorum 3): exactly 3 YES decide on the 3rd vote, all OK.
+        proposals = engine.create_proposals("s", [request(4)] * 16, NOW)
+        gids = np.array(
+            [engine.voter_gid(bytes([i]) * 4) for i in range(1, 4)], np.int64
+        )
+        pids = np.repeat(
+            np.array([p.proposal_id for p in proposals], np.int64), 3
+        )
+        statuses = engine.ingest_columnar(
+            "s", pids, np.tile(gids, 16), np.ones(48, bool), NOW + 1
+        )
+        assert (statuses == int(StatusCode.OK)).all(), statuses
+        assert engine.tracer.counters().get("engine.fresh_dispatches") == 1
+        for p in proposals:
+            assert engine.get_consensus_result("s", p.proposal_id) is True
+
     def test_sharded_timeout_sweep(self, mesh):
         engine = make_sharded_engine(mesh, per_device=4)
         pids = [
