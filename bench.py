@@ -1147,6 +1147,7 @@ if __name__ == "__main__":
             "engine_config4",
             "config5",
             "engine_config5",
+            "engine_config5_retained",
         ):
             print(json.dumps(runners[name]()))
     else:
