@@ -1064,6 +1064,77 @@ def run_config5(
     }
 
 
+def run_deepchain(
+    p_count: int = 64, depth: int = 2048, reps: int = 3
+) -> dict:
+    """Deep-chain replay: 64 fresh sessions × 2048-vote chains, scan kernel
+    vs closed-form kernel on identical batches. The scan pays `depth`
+    sequential steps; the closed form is log-depth (cumsum + reductions) —
+    this mode makes that design win directly measurable on hardware."""
+    import jax
+
+    from hashgraph_tpu.engine.pool import ProposalPool
+    from hashgraph_tpu.ops.decide import required_votes_np
+
+    rng = np.random.default_rng(41)
+    now = 1_700_000_000
+    waves = 8  # pipelined dispatches per timing: device work dominates RTT
+    votes = p_count * depth * waves
+    pool = ProposalPool(p_count * waves, depth)
+    lanes = np.tile(np.arange(depth, dtype=np.int64), p_count)
+    rows = np.repeat(np.arange(p_count, dtype=np.int64), depth)
+    cols = np.tile(np.arange(depth, dtype=np.int64), p_count)
+    vals = rng.random(p_count * depth) < 0.5  # threshold 1.0: never decides
+
+    def run_once(fresh: bool) -> float:
+        n_slots = p_count * waves
+        slot_ids = pool.allocate_batch(
+            keys=[("d", i) for i in range(n_slots)],
+            n=np.full(n_slots, depth),
+            req=required_votes_np(np.full(n_slots, depth), 1.0),
+            cap=np.full(n_slots, depth + 1),
+            gossip=np.zeros(n_slots, bool),
+            liveness=np.ones(n_slots, bool),
+            expiry=np.full(n_slots, now + 1000),
+            created_at=np.full(n_slots, now),
+        )
+        groups = np.asarray(slot_ids, np.int64).reshape(waves, p_count)
+        t0 = time.perf_counter()
+        pendings = [
+            pool.ingest_async_grouped(
+                groups[w], rows, cols, depth, lanes, vals, now, fresh=fresh
+            )
+            for w in range(waves)
+        ]
+        results = pool.complete_all(pendings)
+        dt = time.perf_counter() - t0
+        for statuses, _ in results:
+            assert int(np.sum(statuses == 0)) == p_count * depth
+        pool.release(slot_ids)
+        return dt
+
+    for fresh in (False, True):
+        run_once(fresh)  # compile warmup
+    scan_s = sorted(run_once(False) for _ in range(reps))[reps // 2]
+    fresh_s = sorted(run_once(True) for _ in range(reps))[reps // 2]
+    return {
+        "metric": "deepchain_fresh_vs_scan",
+        "value": round(votes / fresh_s, 1),
+        "unit": "votes/sec",
+        "vs_baseline": round(votes / fresh_s / 1_000_000, 4),
+        "detail": {
+            "sessions": p_count,
+            "chain_depth": depth,
+            "votes": votes,
+            "scan_seconds": round(scan_s, 3),
+            "fresh_seconds": round(fresh_s, 3),
+            "scan_votes_per_sec": round(votes / scan_s, 1),
+            "speedup": round(scan_s / fresh_s, 2),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
 def run_default() -> dict:
     """The driver-visible sweep: engine-level config 3 as the headline,
     every other BASELINE shape in ``detail`` (one JSON line total).
@@ -1130,6 +1201,7 @@ if __name__ == "__main__":
         "engine_config5_retained": lambda: run_engine_config5(retain=True),
         "lanes1024": run_lanes1024,
         "engine_lanes1024": run_engine_lanes1024,
+        "deepchain": run_deepchain,
         "crypto": run_crypto,
         "validated": run_validated,
         "default": run_default,
