@@ -924,6 +924,29 @@ class TestFreshDispatchRouting:
             | (statuses == int(StatusCode.ALREADY_REACHED))
         ).all()
 
+    def test_decided_empty_session_rejects_via_fallback(self):
+        """A session decided with ZERO votes (liveness timeout) still has
+        fresh lane tables, so the fast lane path engages — but the state
+        check must route the dispatch to the scan kernel, which reports the
+        late votes as ALREADY_REACHED."""
+        from hashgraph_tpu.tracing import Tracer
+
+        engine = make_engine(capacity=8, voter_capacity=4)
+        engine.tracer = Tracer(enabled=True)
+        proposal = engine.create_proposal("s", request(n=3, exp=10), NOW)
+        swept = engine.sweep_timeouts(NOW + 100)
+        assert swept and swept[0][2] is True  # liveness YES fills silents
+        gid = engine.voter_gid(b"\x09" * 4)
+        statuses = engine.ingest_columnar(
+            "s",
+            np.array([proposal.proposal_id]),
+            np.array([gid]),
+            np.array([True]),
+            NOW + 101,
+        )
+        assert statuses.tolist() == [int(StatusCode.ALREADY_REACHED)]
+        assert not engine.tracer.counters().get("engine.fresh_dispatches")
+
 
 class TestLaneBatchResolution:
     def test_mixed_existing_and_new(self):
