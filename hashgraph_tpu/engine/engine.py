@@ -1371,12 +1371,44 @@ class TpuConsensusEngine(Generic[Scope]):
         use_fresh = (
             fast_lanes
             and len(order) > 0
-            and not self._multihost
             and self._pool.fresh_ingest_viable(
                 uniq, int(counts.max()), len(order)
             )
         )
-        if use_fresh:
+        fleet_fresh = False
+        if self._multihost:
+            # Fleet agreement on the dispatch PLAN, not just the count: the
+            # fresh and scan kernels are different global programs, so the
+            # path is taken only when EVERY process votes yes (an empty
+            # local batch votes yes if its pool supports the kernel — it
+            # then dispatches one empty fresh call to hold the collective
+            # cadence), AND the fleet-max grid shapes — which the dispatch
+            # pads every process to — fit the cell budget.
+            from jax.experimental import multihost_utils
+
+            fresh_ok = (use_fresh or len(order) == 0) and getattr(
+                self._pool, "supports_fresh_ingest", False
+            )
+            plan = np.array(
+                [
+                    1 if fresh_ok else 0,
+                    len(uniq) if len(order) else 0,
+                    int(counts.max()) if len(order) else 0,
+                ],
+                np.int64,
+            )
+            agreed_plan = multihost_utils.process_allgather(plan)
+            use_fresh = bool(
+                np.min(agreed_plan[..., 0])
+            ) and self._pool.fresh_grid_within_budget(
+                int(np.max(agreed_plan[..., 1])),
+                int(np.max(agreed_plan[..., 2])),
+            )
+            fleet_fresh = use_fresh
+            if use_fresh and len(order) == 0:
+                empty = np.empty(0, np.int64)
+                segs.append((empty, empty, empty, 0, empty, True))
+        if use_fresh and len(order) > 0:
             self.tracer.count("engine.fresh_dispatches")
             segs.append(
                 (
@@ -1422,9 +1454,11 @@ class TpuConsensusEngine(Generic[Scope]):
                         False,
                     )
                 )
-        if self._multihost:
-            # Collective cadence: every process must issue the same number
-            # of dispatches this call, empty ones included.
+        if self._multihost and not fleet_fresh:
+            # Collective cadence for the scan plan: every process must
+            # issue the same number of dispatches this call, empty ones
+            # included. (The fresh plan is exactly one dispatch per process
+            # by construction, so it needs no second collective.)
             from jax.experimental import multihost_utils
 
             agreed = multihost_utils.process_allgather(

@@ -739,10 +739,19 @@ class ProposalPool:
         )
 
     # True where ingest_async_grouped(fresh=True) routes to the closed-form
-    # kernel (single-device and sharded pools). MultiHostPool advertises
-    # False (no fleet shape agreement for the fresh dispatch yet), as does
-    # the opt-in pallas configuration (to keep its A/B meaningful).
+    # kernel (single-device, sharded, and multi-host pools — the engine
+    # additionally agrees the plan fleet-wide in multi-host mode). The
+    # opt-in pallas configuration advertises False to keep its A/B
+    # meaningful.
     supports_fresh_ingest = True
+
+    def fresh_grid_within_budget(self, s_count: int, depth: int) -> bool:
+        """Absolute cell budget for the [S, depth]-padded fresh grid —
+        padding blows up when one huge chain sits amid many shallow slots,
+        at which point the segmented scan wins. Multi-host callers check
+        this against the FLEET-agreed max shapes (the dispatch pads every
+        process to those)."""
+        return _bucket(s_count) * _bucket(depth, floor=1) <= 33_554_432
 
     def fresh_ingest_viable(
         self, uniq: np.ndarray, depth: int, n_items: int
@@ -751,17 +760,16 @@ class ProposalPool:
         ingest dispatch. Owns the invariants next to the kernel they guard:
         the pool supports it, every touched slot is still ACTIVE on the
         host state mirror (rare non-ACTIVE fresh slots: empty sessions
-        decided by timeout), and the [S, depth]-padded grid stays within a
-        cell budget — padding would blow up when one huge chain sits amid
-        many shallow ones, at which point the segmented scan wins. The
-        caller must separately establish freshness + no duplicate voters
+        decided by timeout), and the padded grid stays within the cell
+        budget (with a relative padding-factor guard on top). The caller
+        must separately establish freshness + no duplicate voters
         (fresh_lanes_grouped does both)."""
         if not self.supports_fresh_ingest:
             return False
         cells = _bucket(len(uniq)) * _bucket(depth, floor=1)
         return (
             cells <= max(8 * n_items, 65_536)
-            and cells <= 33_554_432
+            and self.fresh_grid_within_budget(len(uniq), depth)
             and bool((self._state_host[uniq] == STATE_ACTIVE).all())
         )
 

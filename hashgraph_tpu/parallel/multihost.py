@@ -120,11 +120,6 @@ class MultiHostPool(ShardedPool):
     - per-dispatch grid shapes are agreed via one small allgather.
     """
 
-    # The fleet agrees on dispatch shapes per call (allgather in
-    # _dispatch_ingest); the inherited single-process fresh dispatch has no
-    # such agreement, so the closed-form path stays off until it grows one.
-    supports_fresh_ingest = False
-
     def __init__(self, capacity_per_device, voter_capacity, mesh=None):
         mesh = mesh if mesh is not None else distributed_consensus_mesh()
         # Span first: _init_device_arrays (called from the base ctor) needs
@@ -206,43 +201,37 @@ class MultiHostPool(ShardedPool):
         )
 
     def _dispatch_ingest(self, slot_pack, grid_pack):
-        from ..engine.pool import _bucket, _pad2, _pad_slot_ids
-        from ..ops.ingest import pack_slots, unpack_slots
+        return self._fleet_routed_ingest(
+            slot_pack, grid_pack, self._sharded_ingest
+        )
+
+    def _dispatch_ingest_fresh(self, slot_pack, grid_pack):
+        """Fleet closed-form ingest: same shape-agreement + routing as the
+        scan dispatch (the caller — the engine — has already agreed
+        fleet-wide that this call takes the fresh path)."""
+        return self._fleet_routed_ingest(
+            slot_pack, grid_pack, self._sharded_fresh_ingest
+        )
+
+    def _fleet_routed_ingest(self, slot_pack, grid_pack, kernel):
+        """Agree padded shapes across processes (every process must compile
+        and run the same global program), then reuse the shared routing
+        body with the agreed buckets and block-local row positions."""
+        from ..engine.pool import _bucket
 
         s_count, depth = grid_pack.shape
-        # Agree on padded shapes across processes: every process must
-        # compile and run the same global program.
         local_shape = np.array(
             [_bucket(s_count), _bucket(depth, floor=1)], np.int64
         )
         agreed = multihost_utils.process_allgather(local_shape)
-        bucket_s = int(agreed[..., 0].max())
-        bucket_l = int(agreed[..., 1].max())
-
-        slots_g, expired = unpack_slots(slot_pack)
-        local_pack = pack_slots(
-            (slots_g % self.local_capacity).astype(np.int32), expired
+        return self._routed_ingest(
+            slot_pack,
+            grid_pack,
+            kernel,
+            bucket_s=int(agreed[..., 0].max()),
+            bucket_l=int(agreed[..., 1].max()),
+            row_offset=self._dev_lo,
         )
-        _, (pack_g, grid_g), rows, bucket = self._route(
-            slots_g.astype(np.int64),
-            [
-                (local_pack, self.local_capacity),
-                (_pad2(grid_pack, s_count, bucket_l, np.int32), 0),
-            ],
-            bucket=bucket_s,
-        )
-        (
-            self._state, self._yes, self._tot, self._vote_mask,
-            self._vote_val, out,
-        ) = self._sharded_ingest(
-            self._state, self._yes, self._tot, self._vote_mask,
-            self._vote_val, self._n, self._req, self._cap,
-            self._gossip, self._liveness,
-            self._put_batch(pack_g),
-            self._put_batch(grid_g),
-        )
-        # Return row positions relative to this process's local block.
-        return out, rows - self._dev_lo * bucket
 
     def complete_all(self, pendings):
         """Block on in-flight ingests, pulling only addressable shards

@@ -282,21 +282,34 @@ class ShardedPool(ProposalPool):
         (device out [D*B, L+1], row indexer recovering the S input rows)."""
         return self._routed_ingest(slot_pack, grid_pack, self._sharded_ingest)
 
-    def _routed_ingest(self, slot_pack, grid_pack, kernel):
+    def _routed_ingest(
+        self,
+        slot_pack,
+        grid_pack,
+        kernel,
+        bucket_s=None,
+        bucket_l=None,
+        row_offset=0,
+    ):
         """Shared routing/repack body for the scan and closed-form ingest
-        dispatches — one place owns the pad-sentinel/bucket contract."""
+        dispatches — one place owns the pad-sentinel/bucket contract.
+        Multi-host callers pass fleet-agreed ``bucket_s``/``bucket_l`` (so
+        every process compiles the same global program) and their device
+        offset for block-local row positions."""
         s_count, depth = grid_pack.shape
-        bucket_l = _bucket(depth, floor=1)
+        if bucket_l is None:
+            bucket_l = _bucket(depth, floor=1)
         slots_g, expired = unpack_slots(slot_pack)
         local_pack = pack_slots(
             (slots_g % self.local_capacity).astype(np.int32), expired
         )
-        _, (pack_g, grid_g), rows, _ = self._route(
+        _, (pack_g, grid_g), rows, bucket = self._route(
             slots_g.astype(np.int64),
             [
                 (local_pack, self.local_capacity),
                 (_pad2(grid_pack, s_count, bucket_l, np.int32), 0),
             ],
+            bucket=bucket_s,
         )
         (
             self._state, self._yes, self._tot, self._vote_mask,
@@ -308,7 +321,7 @@ class ShardedPool(ProposalPool):
             self._put_batch(pack_g),
             self._put_batch(grid_g),
         )
-        return out, rows
+        return out, rows - row_offset * bucket
 
     def _dispatch_ingest_fresh(self, slot_pack, grid_pack):
         """Sharded closed-form ingest; same routing contract as
