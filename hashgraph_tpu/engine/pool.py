@@ -95,7 +95,7 @@ class PendingIngest:
     row_select: np.ndarray  # routed-row indexer: out[row_select] -> [S, :]
 
 
-@dataclass
+@dataclass(slots=True)
 class SlotMeta:
     """Host-side bookkeeping for one allocated slot. Voter-lane assignments
     live in the pool's dense ``_lane_gids``/``_lane_count`` tables (shared by
@@ -209,6 +209,7 @@ class ProposalPool:
         if use_pallas is None:
             use_pallas = os.environ.get("HASHGRAPH_TPU_PALLAS", "") == "1"
         self._ingest_kernel = ingest_kernel
+        self._use_pallas = use_pallas
         if use_pallas:
             from ..ops.pallas_ingest import pallas_ingest_body
 
@@ -598,9 +599,13 @@ class ProposalPool:
             raise PoolFullError(
                 f"need {count} slots, {len(self._free)} free of {self.capacity}"
             )
-        slots = [self._free.pop() for _ in range(count)]
+        # Claim the tail of the free list in one slice (same slots, same
+        # order as count pop() calls would yield).
+        slots = self._free[-count:][::-1]
+        del self._free[-count:]
+        slots_arr = np.asarray(slots, np.int32)
         self._dispatch_activate(
-            np.asarray(slots, np.int32),
+            slots_arr,
             n,
             np.asarray(req, np.int32),
             np.asarray(cap, np.int32),
@@ -612,12 +617,13 @@ class ProposalPool:
         created_at = np.asarray(created_at, np.int64)
         # Lane rows need no clearing here: free slots always have cleared
         # rows (initialised at construction, retired on release).
-        for i, slot in enumerate(slots):
-            self._state_host[slot] = STATE_ACTIVE
-            self._expiry_host[slot] = expiry[i]
-            self._meta[slot] = SlotMeta(
-                key=keys[i], expiry=int(expiry[i]), created_at=int(created_at[i])
-            )
+        self._state_host[slots_arr] = STATE_ACTIVE
+        self._expiry_host[slots_arr] = expiry
+        meta = self._meta
+        for slot, key, exp, cre in zip(
+            slots, keys, expiry.tolist(), created_at.tolist()
+        ):
+            meta[slot] = SlotMeta(key=key, expiry=exp, created_at=cre)
         return slots
 
     def load_rows(
@@ -802,7 +808,15 @@ class ProposalPool:
         if len(row):
             voter_grid[row, col] = np.asarray(lanes, np.int32)
             valbit[row, col] = np.asarray(values, np.int32) | 2  # value | valid
-        grid = pack_grid(voter_grid, valbit & 1, valbit >> 1)
+        # Narrow grid cells to the pool's lane range (uint8/uint16) — the
+        # grid is the dominant upload of every dispatch. The Pallas kernel
+        # keeps the fixed int32 layout it was written against.
+        grid = pack_grid(
+            voter_grid,
+            valbit & 1,
+            valbit >> 1,
+            voter_capacity=None if self._use_pallas else self.voter_capacity,
+        )
 
         expired = self._expiry_host[uniq] <= now
         dispatch = (
@@ -1012,7 +1026,7 @@ class ProposalPool:
             self._gossip,
             self._liveness,
             jnp.asarray(_pad_slot_ids(slot_pack, bucket_s, self.capacity)),
-            jnp.asarray(_pad2(grid_pack, bucket_s, bucket_l, np.int32)),
+            jnp.asarray(_pad2(grid_pack, bucket_s, bucket_l, grid_pack.dtype)),
         )
         return out, np.arange(s_count)
 
@@ -1041,7 +1055,7 @@ class ProposalPool:
             self._gossip,
             self._liveness,
             jnp.asarray(_pad_slot_ids(slot_pack, bucket_s, self.capacity)),
-            jnp.asarray(_pad2(grid_pack, bucket_s, bucket_l, np.int32)),
+            jnp.asarray(_pad2(grid_pack, bucket_s, bucket_l, grid_pack.dtype)),
         )
         return out, np.arange(s_count)
 

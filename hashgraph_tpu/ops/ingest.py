@@ -22,9 +22,13 @@ in ONE):
 - ``slot_pack`` int32[S]: slot id in bits 0-29, ``expired`` flag in bit 30.
   Pad rows carry slot id == P (out of range): gathers clip (values unused),
   scatters drop — so pad rows can never corrupt slot 0.
-- ``grid_pack`` int32[S, L]: voter lane in bits 0-15, vote value in bit 16,
-  cell-valid in bit 17. Pad cells within a real row have valid == 0.
-- output int32[S, L+1]: per-vote statuses in columns [0, L), the row's final
+- ``grid_pack`` [S, L]: voter lane in the low bits, vote value and
+  cell-valid above them. The dtype is the narrowest that fits the pool's
+  lane range (uint8 for voter_capacity <= 64, uint16 <= 16384, else int32
+  with lane bits 0-15 / value bit 16 / valid bit 17 — see
+  :func:`grid_layout`); the grid is the dominant upload, so narrowing it
+  cuts the per-dispatch wire bytes 4x/2x. Pad cells have valid == 0.
+- output int8[S, L+1]: per-vote statuses in columns [0, L), the row's final
   lifecycle state in column L.
 """
 
@@ -70,15 +74,48 @@ def unpack_slots(slot_pack: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return packed & _SLOT_MASK, ((packed >> _EXPIRED_BIT) & 1).astype(bool)
 
 
+def grid_dtype(voter_capacity: int):
+    """Narrowest packed-grid dtype that fits lane + value + valid bits.
+    The grid is the big host->device transfer of every ingest dispatch
+    (uploads dominate on a tunneled link), so capacity <= 64 pools ship
+    uint8 cells and capacity <= 16384 ship uint16 — 4x / 2x less wire
+    than the general int32 layout."""
+    if voter_capacity <= 64:
+        return np.uint8
+    if voter_capacity <= 16384:
+        return np.uint16
+    return np.int32
+
+
+def grid_layout(dtype) -> tuple[int, int, int]:
+    """(lane_mask, val_bit, valid_bit) for a packed-grid dtype. Kernels
+    derive the layout from the traced array's dtype, so host pack and
+    device unpack can never disagree."""
+    dt = np.dtype(dtype)
+    if dt == np.uint8:
+        return (1 << 6) - 1, 6, 7
+    if dt == np.uint16:
+        return (1 << 14) - 1, 14, 15
+    return _LANE_MASK, _VAL_BIT, _VALID_BIT
+
+
 def pack_grid(
-    voter_grid: np.ndarray, val_grid: np.ndarray, valid_grid: np.ndarray
+    voter_grid: np.ndarray,
+    val_grid: np.ndarray,
+    valid_grid: np.ndarray,
+    voter_capacity: int | None = None,
 ) -> np.ndarray:
-    """Host-side: fuse lane/value/valid grids into one int32 transfer."""
+    """Host-side: fuse lane/value/valid grids into one packed transfer.
+    ``voter_capacity`` (when given) selects the narrowest dtype whose lane
+    field still holds capacity-1; None keeps the original int32 layout
+    (direct callers, and the Pallas kernel's fixed int32 unpack)."""
+    dt = np.int32 if voter_capacity is None else grid_dtype(voter_capacity)
+    _, val_bit, valid_bit = grid_layout(dt)
     return (
-        np.asarray(voter_grid, np.int32)
-        | (np.asarray(val_grid, np.int32) << _VAL_BIT)
-        | (np.asarray(valid_grid, np.int32) << _VALID_BIT)
-    ).astype(np.int32)
+        np.asarray(voter_grid, dt)
+        | (np.asarray(val_grid, dt) << val_bit)
+        | (np.asarray(valid_grid, dt) << valid_bit)
+    ).astype(dt)
 
 
 def group_batch(slot_idx: np.ndarray):
@@ -142,9 +179,10 @@ def ingest_body(
 
     slot_ids = slot_pack & _SLOT_MASK
     expired = ((slot_pack >> _EXPIRED_BIT) & 1).astype(bool)
-    voter_grid = grid_pack & _LANE_MASK
-    val_grid = ((grid_pack >> _VAL_BIT) & 1).astype(bool)
-    valid_grid = ((grid_pack >> _VALID_BIT) & 1).astype(bool)
+    lane_mask, val_bit, valid_bit = grid_layout(grid_pack.dtype)
+    voter_grid = (grid_pack & lane_mask).astype(jnp.int32)
+    val_grid = ((grid_pack >> val_bit) & 1).astype(bool)
+    valid_grid = ((grid_pack >> valid_bit) & 1).astype(bool)
 
     gather = lambda arr: jnp.take(arr, slot_ids, axis=0, mode="clip")
     row_state = gather(state)
@@ -278,9 +316,10 @@ def fresh_ingest_body(
 
     slot_ids = slot_pack & _SLOT_MASK
     expired = ((slot_pack >> _EXPIRED_BIT) & 1).astype(bool)
-    voter_grid = grid_pack & _LANE_MASK
-    val_grid = ((grid_pack >> _VAL_BIT) & 1).astype(bool)
-    valid = ((grid_pack >> _VALID_BIT) & 1).astype(bool)
+    lane_mask, val_bit, valid_bit = grid_layout(grid_pack.dtype)
+    voter_grid = (grid_pack & lane_mask).astype(jnp.int32)
+    val_grid = ((grid_pack >> val_bit) & 1).astype(bool)
+    valid = ((grid_pack >> valid_bit) & 1).astype(bool)
 
     gather = lambda arr: jnp.take(arr, slot_ids, axis=0, mode="clip")
     row_n = gather(n)[:, None]

@@ -307,7 +307,7 @@ class ShardedPool(ProposalPool):
             slots_g.astype(np.int64),
             [
                 (local_pack, self.local_capacity),
-                (_pad2(grid_pack, s_count, bucket_l, np.int32), 0),
+                (_pad2(grid_pack, s_count, bucket_l, grid_pack.dtype), 0),
             ],
             bucket=bucket_s,
         )

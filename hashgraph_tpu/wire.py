@@ -182,17 +182,20 @@ class Vote:
         return vote
 
     def clone(self) -> "Vote":
-        return Vote(
-            vote_id=self.vote_id,
-            vote_owner=self.vote_owner,
-            proposal_id=self.proposal_id,
-            timestamp=self.timestamp,
-            vote=self.vote,
-            parent_hash=self.parent_hash,
-            received_hash=self.received_hash,
-            vote_hash=self.vote_hash,
-            signature=self.signature,
-        )
+        # Direct slot copies, not a kwargs __init__: this runs once per vote
+        # on every export/retention decode, and the constructor's keyword
+        # dispatch is ~2.5x the cost of nine attribute stores.
+        new = Vote.__new__(Vote)
+        new.vote_id = self.vote_id
+        new.vote_owner = self.vote_owner
+        new.proposal_id = self.proposal_id
+        new.timestamp = self.timestamp
+        new.vote = self.vote
+        new.parent_hash = self.parent_hash
+        new.received_hash = self.received_hash
+        new.vote_hash = self.vote_hash
+        new.signature = self.signature
+        return new
 
 
 @dataclass(slots=True)
@@ -280,15 +283,17 @@ class Proposal:
         return proposal
 
     def clone(self) -> "Proposal":
-        return Proposal(
-            name=self.name,
-            payload=self.payload,
-            proposal_id=self.proposal_id,
-            proposal_owner=self.proposal_owner,
-            votes=[v.clone() for v in self.votes],
-            expected_voters_count=self.expected_voters_count,
-            round=self.round,
-            timestamp=self.timestamp,
-            expiration_timestamp=self.expiration_timestamp,
-            liveness_criteria_yes=self.liveness_criteria_yes,
-        )
+        # Direct slot copies (see Vote.clone): batch creation clones every
+        # minted proposal on return, so this is on the registration hot path.
+        new = Proposal.__new__(Proposal)
+        new.name = self.name
+        new.payload = self.payload
+        new.proposal_id = self.proposal_id
+        new.proposal_owner = self.proposal_owner
+        new.votes = [v.clone() for v in self.votes]
+        new.expected_voters_count = self.expected_voters_count
+        new.round = self.round
+        new.timestamp = self.timestamp
+        new.expiration_timestamp = self.expiration_timestamp
+        new.liveness_criteria_yes = self.liveness_criteria_yes
+        return new

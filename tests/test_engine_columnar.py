@@ -570,6 +570,80 @@ class TestMultiScopeColumnar:
             int(StatusCode.SESSION_NOT_FOUND),  # unknown pid
         ]
 
+    def test_wide_pid_cannot_alias_fused_composite_key(self):
+        """The fused multi-scope lookup keys on scope_ordinal << 32 | pid.
+        A caller-supplied pid wider than u32 (e.g. (1 << 32) | real_pid)
+        must resolve as not-found, never alias another scope's session."""
+        engine = make_engine()
+        [pa] = engine.create_proposals("a", [request(n=4)], NOW)
+        [pb] = engine.create_proposals("b", [request(n=4)], NOW)
+        gid = engine.voter_gid(b"\x66" * 20)
+        wide = (np.int64(1) << 32) | np.int64(pb.proposal_id)
+        statuses = engine.ingest_columnar_multi(
+            ["a", "b"],
+            np.array([0, 0, 1], np.int64),
+            # Row 1's wide pid equals the composite key of scope b's
+            # session — a missing u32 guard would misroute the vote.
+            np.array([pa.proposal_id, wide, pb.proposal_id], np.int64),
+            np.array([gid] * 3, np.int64),
+            np.ones(3, bool),
+            NOW + 1,
+        )
+        assert statuses.tolist() == [
+            int(StatusCode.OK),
+            int(StatusCode.SESSION_NOT_FOUND),
+            int(StatusCode.OK),
+        ]
+        # The wide row must not have been credited to scope b's session:
+        # exactly the one direct vote, not two.
+        assert len(engine.export_session("b", pb.proposal_id).votes) <= 1
+        assert engine.get_scope_stats("b").total_sessions == 1
+
+    def test_fused_cache_invalidated_by_membership_change(self):
+        """Delete + recreate between two multi calls: the second call must
+        resolve the NEW sessions (epoch-keyed fused cache, not stale)."""
+        engine = make_engine()
+        scopes = ["x", "y"]
+        gid = engine.voter_gid(b"\x55" * 20)
+        first = {
+            s: engine.create_proposals(s, [request(n=4)], NOW)[0]
+            for s in scopes
+        }
+        st1 = engine.ingest_columnar_multi(
+            scopes,
+            np.array([0, 1], np.int64),
+            np.array(
+                [first["x"].proposal_id, first["y"].proposal_id], np.int64
+            ),
+            np.array([gid] * 2, np.int64),
+            np.ones(2, bool),
+            NOW + 1,
+        )
+        assert st1.tolist() == [int(StatusCode.OK)] * 2
+        engine.delete_scope("x")
+        [nx] = engine.create_proposals("x", [request(n=4)], NOW)
+        gid2 = engine.voter_gid(b"\x54" * 20)
+        st2 = engine.ingest_columnar_multi(
+            scopes,
+            np.array([0, 0, 1], np.int64),
+            np.array(
+                [
+                    nx.proposal_id,
+                    first["x"].proposal_id,  # deleted session
+                    first["y"].proposal_id,
+                ],
+                np.int64,
+            ),
+            np.array([gid2] * 3, np.int64),
+            np.ones(3, bool),
+            NOW + 1,
+        )
+        assert st2.tolist() == [
+            int(StatusCode.OK),
+            int(StatusCode.SESSION_NOT_FOUND),
+            int(StatusCode.OK),
+        ]
+
 
 class TestWireRetention:
     """Opt-in wire_votes retention closes the columnar chain gap: a proposal

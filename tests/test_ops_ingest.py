@@ -115,9 +115,10 @@ def make_pool(configs):
     )
 
 
-def run_ingest(pool, slots, voters, vals, now, kernel=None):
+def run_ingest(pool, slots, voters, vals, now, kernel=None, voter_capacity=None):
     """Group the flat batch, run the kernel, return per-vote statuses in
-    batch order plus updated numpy pool arrays."""
+    batch order plus updated numpy pool arrays. ``voter_capacity`` selects
+    the narrow packed-grid dtype (uint8/uint16), as the pool does."""
     slots = np.asarray(slots, np.int64)
     uniq, row, col, depth = group_batch(slots)
     s_count = len(uniq)
@@ -141,7 +142,11 @@ def run_ingest(pool, slots, voters, vals, now, kernel=None):
         jnp.asarray(pool["gossip"]),
         jnp.asarray(pool["liveness"]),
         jnp.asarray(pack_slots(uniq.astype(np.int32), expired)),
-        jnp.asarray(pack_grid(voter_grid, val_grid, valid_grid)),
+        jnp.asarray(
+            pack_grid(
+                voter_grid, val_grid, valid_grid, voter_capacity=voter_capacity
+            )
+        ),
     )
     state, yes, tot, vote_mask, vote_val, packed_out = map(np.asarray, out)
     pool.update(state=state, yes=yes, tot=tot, vote_mask=vote_mask, vote_val=vote_val)
@@ -324,6 +329,48 @@ class TestIngestParity:
         )
         for key in ("state", "yes", "tot", "vote_mask", "vote_val"):
             assert (pool_scan[key] == pool_fresh[key]).all(), key
+
+    @pytest.mark.parametrize("seed", range(4))
+    @pytest.mark.parametrize("cap_hint", [16, 4096, None])
+    def test_grid_dtype_parity(self, seed, cap_hint):
+        """Narrow packed grids (uint8 for capacity<=64, uint16 for <=16384)
+        must be bit-identical to the int32 layout on BOTH kernels — the
+        dtype only changes the transfer width, never the unpacked lanes."""
+        from hashgraph_tpu.ops.ingest import fresh_ingest_kernel, grid_dtype
+
+        if cap_hint is not None:
+            expect = np.uint8 if cap_hint <= 64 else np.uint16
+            assert grid_dtype(cap_hint) == expect
+        rng = np.random.default_rng(4200 + seed)
+        configs = []
+        for _ in range(6):
+            n = int(rng.integers(1, 13))
+            mode = "gossipsub" if rng.random() < 0.5 else "p2p"
+            configs.append(
+                (n, mode, bool(rng.random() < 0.5),
+                 float(rng.choice([2 / 3, 0.9])), int(rng.choice([5, 1000])))
+            )
+        trace = []
+        for slot in range(len(configs)):
+            for v in rng.permutation(V_CAP)[: int(rng.integers(1, V_CAP))]:
+                trace.append((slot, int(v), bool(rng.random() < 0.5)))
+        rng.shuffle(trace)
+        slots = np.array([t[0] for t in trace])
+        voters = np.array([t[1] for t in trace], np.int32)
+        vals = np.array([t[2] for t in trace], bool)
+        for kernel in (None, fresh_ingest_kernel):
+            pool_ref, _ = make_pool(configs)
+            pool_nar, _ = make_pool(configs)
+            st_ref = run_ingest(
+                pool_ref, slots, voters, vals, NOW + 6, kernel=kernel
+            )
+            st_nar = run_ingest(
+                pool_nar, slots, voters, vals, NOW + 6, kernel=kernel,
+                voter_capacity=cap_hint,
+            )
+            assert st_ref.tolist() == st_nar.tolist()
+            for key in ("state", "yes", "tot", "vote_mask", "vote_val"):
+                assert (pool_ref[key] == pool_nar[key]).all(), key
 
     def test_pad_rows_cannot_corrupt_pool(self):
         pool, sessions = make_pool([(3, "gossipsub", True, 2 / 3, 1000)])
