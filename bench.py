@@ -296,7 +296,7 @@ def run_engine_config5(
     scopes: int = 256,
     proposals_per_scope: int = 128,
     v_count: int = 48,
-    waves: int = 4,
+    waves: int = 8,
     retain: bool = False,
 ) -> dict:
     """Engine-level config 5: mixed-scope streaming churn. Every wave

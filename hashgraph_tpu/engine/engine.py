@@ -98,6 +98,23 @@ _STATE_TO_SCALAR = {
 }
 
 
+class _CreationCols:
+    """Per-proposal scalar columns accumulated while batch creation mints
+    its Proposal objects. The allocator turns these plain int/float/bool
+    lists into device config arrays with np.asarray — several times cheaper
+    than re-walking the freshly-built objects with fromiter generators."""
+
+    __slots__ = ("n", "expiry", "liveness", "thr", "gossip", "maxr")
+
+    def __init__(self):
+        self.n: list[int] = []
+        self.expiry: list[int] = []
+        self.liveness: list[bool] = []
+        self.thr: list[float] = []
+        self.gossip: list[bool] = []
+        self.maxr: list[int] = []
+
+
 @dataclass(slots=True)
 class SessionRecord(Generic[Scope]):
     """Host-side view of one session (scalar bookkeeping the device doesn't
@@ -412,6 +429,7 @@ class TpuConsensusEngine(Generic[Scope]):
         entries: list = []
         spans: list = []
         fallbacks: list = []
+        cols = _CreationCols()
         for idx, (scope, requests) in enumerate(items):
             existing = len(self._scopes.get(scope, []))
             if existing + len(requests) > self._max_sessions_per_scope:
@@ -419,13 +437,13 @@ class TpuConsensusEngine(Generic[Scope]):
                 spans.append(None)
                 continue
             proposals, configs = self._prepare_creation(
-                scope, requests, now, config
+                scope, requests, now, config, cols
             )
             spans.append((len(entries), len(proposals)))
             entries.extend(
                 (scope, p, c) for p, c in zip(proposals, configs)
             )
-        created = self._allocate_and_register(entries, now)
+        created = self._allocate_and_register(entries, now, cols)
         for idx, span in enumerate(spans):
             if span is not None:
                 start, count = span
@@ -443,10 +461,14 @@ class TpuConsensusEngine(Generic[Scope]):
         requests: list[CreateProposalRequest],
         now: int,
         config: ConsensusConfig | None,
+        cols: "_CreationCols",
     ) -> tuple[list[Proposal], list[ConsensusConfig]]:
         """Python-side prep shared by the batch creators: mint proposals
         with batch-drawn ids (single-host) or deterministic ids (multi-host)
-        and resolve configs with per-batch memoization."""
+        and resolve configs with per-batch memoization. Per-proposal scalars
+        the allocator needs (n, expiry, config fields) accumulate into
+        ``cols`` during this loop — np.asarray over plain int lists later is
+        several times cheaper than re-walking the objects with fromiter."""
         proposals: list[Proposal] = []
         configs: list[ConsensusConfig] = []
         # Single-host fast path: draw the whole batch's proposal ids in one
@@ -460,83 +482,94 @@ class TpuConsensusEngine(Generic[Scope]):
         # Config resolution is identical for requests sharing (expiration,
         # liveness) when no per-proposal override exists — memoize per batch.
         cfg_cache: dict = {}
-        if batch_ids is not None:
-            # Hot single-host loop: ids are pre-drawn and unique, so the
-            # body is mint -> validate -> memoized config resolve, with the
-            # multi-host-only branch hoisted out of the loop entirely.
-            add_p = proposals.append
-            add_c = configs.append
-            for request, pid in zip(requests, batch_ids.tolist()):
-                proposal = request.into_proposal(now, pid=pid)
-                validate_proposal_timestamp(proposal.expiration_timestamp, now)
-                add_p(proposal)
-                key = (
-                    proposal.expiration_timestamp,
-                    proposal.liveness_criteria_yes,
-                )
-                resolved = cfg_cache.get(key)
-                if resolved is None:
-                    resolved = self._resolve_config(scope, config, proposal)
-                    cfg_cache[key] = resolved
-                add_c(resolved)
-            return proposals, configs
-        batch_pids: set[int] = set()
-        for request in requests:
-            proposal = request.into_proposal(now)
-            self._ensure_unique_pid(scope, proposal, taken=batch_pids)
-            batch_pids.add(proposal.proposal_id)
+        add_p = proposals.append
+        add_c = configs.append
+        c_n = cols.n.append
+        c_exp = cols.expiry.append
+        c_live = cols.liveness.append
+        c_thr = cols.thr.append
+        c_gos = cols.gossip.append
+        c_maxr = cols.maxr.append
+        batch_pids: set[int] | None = None if batch_ids is not None else set()
+        pid_iter = (
+            batch_ids.tolist() if batch_ids is not None else [None] * len(requests)
+        )
+        for request, pid in zip(requests, pid_iter):
+            proposal = request.into_proposal(now, pid=pid)
+            if batch_pids is not None:
+                self._ensure_unique_pid(scope, proposal, taken=batch_pids)
+                batch_pids.add(proposal.proposal_id)
             validate_proposal_timestamp(proposal.expiration_timestamp, now)
-            proposals.append(proposal)
+            add_p(proposal)
             key = (
                 proposal.expiration_timestamp,
                 proposal.liveness_criteria_yes,
             )
-            resolved = cfg_cache.get(key)
-            if resolved is None:
+            entry = cfg_cache.get(key)
+            if entry is None:
                 resolved = self._resolve_config(scope, config, proposal)
-                cfg_cache[key] = resolved
-            configs.append(resolved)
+                entry = (
+                    resolved,
+                    resolved.consensus_threshold,
+                    resolved.use_gossipsub_rounds,
+                    resolved.max_rounds,
+                )
+                cfg_cache[key] = entry
+            add_c(entry[0])
+            c_n(request.expected_voters_count)
+            c_exp(proposal.expiration_timestamp)
+            c_live(proposal.liveness_criteria_yes)
+            c_thr(entry[1])
+            c_gos(entry[2])
+            c_maxr(entry[3])
         return proposals, configs
 
     def _allocate_and_register(
         self,
         entries: "list[tuple[Scope, Proposal, ConsensusConfig]]",
         now: int,
+        cols: "_CreationCols",
     ) -> list[Proposal]:
         """One pool.allocate_batch for every (scope, proposal, config) entry
         (first-fit against the free budget; the rest host-spill), then host
-        registration. Returns clones in entry order."""
+        registration. Returns clones in entry order. ``cols`` carries the
+        per-entry scalars collected during _prepare_creation, so the device
+        config arrays build from plain int lists instead of re-walking the
+        proposal/config objects."""
         from ..ops.decide import required_votes_np
 
         free = self._pool.free_slots
-        fit_idx: list[int] = []
-        for i, (_, proposal, _) in enumerate(entries):
-            if (
-                proposal.expected_voters_count <= self._pool.voter_capacity
-                and len(fit_idx) < free
-            ):
-                fit_idx.append(i)
+        n_all = np.asarray(cols.n, np.int64)
+        # First-fit against the free budget, vectorized: rows small enough
+        # for the lane grid claim slots in entry order until the budget is
+        # spent (identical to the old per-entry scan).
+        ok = n_all <= self._pool.voter_capacity
+        fit_mask = ok & (np.cumsum(ok) <= free)
+        fit_idx = np.nonzero(fit_mask)[0]
+        all_fit = len(fit_idx) == len(entries)
         slots_by_item: dict[int, int] = {}
-        if fit_idx:
+        slots: list[int] = []
+        if len(fit_idx):
             count = len(fit_idx)
-            n_arr = np.fromiter(
-                (entries[i][1].expected_voters_count for i in fit_idx),
-                np.int64,
-                count,
-            )
-            thr_arr = np.fromiter(
-                (entries[i][2].consensus_threshold for i in fit_idx),
-                np.float64,
-                count,
-            )
-            gossip_arr = np.fromiter(
-                (entries[i][2].use_gossipsub_rounds for i in fit_idx),
-                bool,
-                count,
-            )
-            maxr_arr = np.fromiter(
-                (entries[i][2].max_rounds for i in fit_idx), np.int64, count
-            )
+            if all_fit:
+                n_arr = n_all
+                thr_arr = np.asarray(cols.thr, np.float64)
+                gossip_arr = np.asarray(cols.gossip, bool)
+                maxr_arr = np.asarray(cols.maxr, np.int64)
+                expiry_arr = np.asarray(cols.expiry, np.int64)
+                liveness_arr = np.asarray(cols.liveness, bool)
+                keys = [(s, p.proposal_id) for s, p, _ in entries]
+            else:
+                n_arr = n_all[fit_idx]
+                thr_arr = np.asarray(cols.thr, np.float64)[fit_idx]
+                gossip_arr = np.asarray(cols.gossip, bool)[fit_idx]
+                maxr_arr = np.asarray(cols.maxr, np.int64)[fit_idx]
+                expiry_arr = np.asarray(cols.expiry, np.int64)[fit_idx]
+                liveness_arr = np.asarray(cols.liveness, bool)[fit_idx]
+                keys = [
+                    (entries[i][0], entries[i][1].proposal_id)
+                    for i in fit_idx.tolist()
+                ]
             req_arr = required_votes_np(n_arr, thr_arr)
             # max_round_limit semantics (reference: src/session.rs:120-128):
             # gossipsub -> max_rounds; P2P -> explicit override, else the
@@ -549,27 +582,17 @@ class TpuConsensusEngine(Generic[Scope]):
                 np.where(maxr_arr == 0, req_arr, maxr_arr),
             )
             slots = self._pool.allocate_batch(
-                keys=[
-                    (entries[i][0], entries[i][1].proposal_id) for i in fit_idx
-                ],
+                keys=keys,
                 n=n_arr,
                 req=req_arr,
                 cap=cap_arr,
                 gossip=gossip_arr,
-                liveness=np.fromiter(
-                    (entries[i][1].liveness_criteria_yes for i in fit_idx),
-                    bool,
-                    count,
-                ),
-                expiry=np.fromiter(
-                    (entries[i][1].expiration_timestamp for i in fit_idx),
-                    np.int64,
-                    count,
-                ),
+                liveness=liveness_arr,
+                expiry=expiry_arr,
                 created_at=np.full(count, now, np.int64),
             )
-            if len(fit_idx) != len(entries):
-                slots_by_item = dict(zip(fit_idx, slots))
+            if not all_fit:
+                slots_by_item = dict(zip(fit_idx.tolist(), slots))
 
         # Entries arrive grouped by scope (one span per input item), so the
         # scope-keyed bookkeeping caches the current scope's slot list
@@ -578,7 +601,6 @@ class TpuConsensusEngine(Generic[Scope]):
         # dict probe: fit_idx is then simply 0..len(entries).
         records = self._records
         index = self._index
-        all_fit = len(fit_idx) == len(entries)
         touched: set = set()
         cur_scope: object = object()  # sentinel unequal to any real scope
         cur_list: list = []
@@ -1213,14 +1235,36 @@ class TpuConsensusEngine(Generic[Scope]):
             contig[1:] = starts[1:] == ends[:-1]
             contig[seg_start] = True  # span breaks at slot boundaries are fine
         if contig.all():
+            # All per-slot offset arrays are built in ONE pass (each slot's
+            # cells plus a trailing end cell), so the per-slot loop is just
+            # two small slices — per-slot np.append/tobytes overhead was
+            # ~15us x touched-slots, the retained-churn bench's biggest
+            # line item.
+            s_count = len(uniq)
+            counts = np.diff(seg_bounds)
+            base = starts[seg_start]  # [S] span base per slot
+            k_of_row = np.repeat(np.arange(s_count), counts)
+            all_off = np.empty(len(rows) + s_count, np.int64)
+            all_off[np.arange(len(rows)) + k_of_row] = starts - base[k_of_row]
+            end_pos = seg_bounds[1:] + np.arange(s_count)
+            seg_ends = ends[seg_bounds[1:] - 1]
+            all_off[end_pos] = seg_ends - base
+            data_bytes = data_arr.tobytes()
+            records = self._records
+            base_l = base.tolist()
+            ends_l = seg_ends.tolist()
+            lo_l = (seg_bounds[:-1] + np.arange(s_count)).tolist()
+            hi_l = end_pos.tolist()
             for k, slot in enumerate(uniq.tolist()):
-                lo, hi = int(seg_bounds[k]), int(seg_bounds[k + 1])
-                base = int(starts[lo])
-                seg_off = np.append(starts[lo:hi], ends[hi - 1]) - base
-                seg_blob = data_arr[base : int(ends[hi - 1])].tobytes()
-                record = self._records[int(slot)]
+                record = records[slot]
+                seq = record.arrival_seq
+                record.arrival_seq = seq + 1
                 record.retained_wire.append(
-                    (record.next_arrival_seq(), seg_blob, seg_off)
+                    (
+                        seq,
+                        data_bytes[base_l[k] : ends_l[k]],
+                        all_off[lo_l[k] : hi_l[k] + 1].copy(),
+                    )
                 )
             return
 
