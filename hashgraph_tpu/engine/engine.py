@@ -1410,7 +1410,16 @@ class TpuConsensusEngine(Generic[Scope]):
             if event is not None and self._owns_slot(int(slots[i])):
                 self._emit(record.scope, event)
 
-        dev_rows = np.nonzero(found & (slots >= 0))[0]
+        dev_mask = found & (slots >= 0)
+        # Identity fast path: when EVERY row reaches the device (the
+        # streaming steady state — no unknown sessions, no stale gids, no
+        # spills), skip materializing the row-index array and the gathers
+        # through it; ``sel`` below is then just ``order``.
+        dev_rows = (
+            None
+            if len(dev_mask) and dev_mask.all()
+            else np.nonzero(dev_mask)[0]
+        )
 
         # ── Fused sorted-domain pipeline ───────────────────────────────
         # ONE stable slot-sort of the batch; grouping, lane assignment,
@@ -1430,16 +1439,19 @@ class TpuConsensusEngine(Generic[Scope]):
             return s_sorted[starts_idx], starts_idx, grp, col, counts
 
         order = np.empty(0, np.int64)
+        sel = order  # statuses-row index per sorted item (= dev_rows[order])
         lanes_sorted = np.empty(0, np.int32)
         vals_sorted = np.empty(0, bool)
         uniq = starts_idx = grp_sorted = col_sorted = counts = None
         fast_lanes = False
-        if dev_rows.size:
-            dslots = slots[dev_rows]
+        if dev_rows is None or dev_rows.size:
+            dslots = slots if dev_rows is None else slots[dev_rows]
+            dgids = voter_gids if dev_rows is None else voter_gids[dev_rows]
             order = np.argsort(dslots, kind="stable")
+            sel = order if dev_rows is None else dev_rows[order]
             s_sorted = dslots[order]
             uniq, starts_idx, grp_sorted, col_sorted, counts = _group(s_sorted)
-            gid_idx_sorted = voter_gids[dev_rows][order] & 0xFFFFFFFF
+            gid_idx_sorted = voter_gids[sel] & 0xFFFFFFFF
             lanes_sorted = self._pool.fresh_lanes_grouped(
                 s_sorted, gid_idx_sorted, col_sorted, uniq, counts
             )
@@ -1448,22 +1460,23 @@ class TpuConsensusEngine(Generic[Scope]):
                 # General path (pre-voted slots or an in-batch duplicate
                 # voter); assume_live: the gids_live gate above ran.
                 lanes_sorted = self._pool.lanes_for_batch(
-                    dslots, voter_gids[dev_rows], assume_live=True
+                    dslots, dgids, assume_live=True
                 )[order]
             no_lane = lanes_sorted < 0
             if no_lane.any():
-                statuses[dev_rows[order[no_lane]]] = int(
+                statuses[sel[no_lane]] = int(
                     StatusCode.VOTER_CAPACITY_EXCEEDED
                 )
                 keep = ~no_lane
                 order = order[keep]
+                sel = sel[keep]
                 s_sorted = s_sorted[keep]
                 lanes_sorted = lanes_sorted[keep]
                 if len(order):
                     uniq, starts_idx, grp_sorted, col_sorted, counts = _group(
                         s_sorted
                     )
-            vals_sorted = values[dev_rows][order]
+            vals_sorted = values[sel]
 
         # Dispatch plan. Preferred: ONE closed-form (scan-free) dispatch for
         # the whole batch — valid exactly when the fast lane path ran (fresh
@@ -1534,9 +1547,9 @@ class TpuConsensusEngine(Generic[Scope]):
             if depth > max_depth:
                 d = max_depth
                 for k in range(-(-depth // d)):
-                    sel = counts > k * d
-                    g_starts = starts_idx[sel] + k * d
-                    g_lens = np.minimum(counts[sel] - k * d, d)
+                    seg_mask = counts > k * d
+                    g_starts = starts_idx[seg_mask] + k * d
+                    g_lens = np.minimum(counts[seg_mask] - k * d, d)
                     m = int(g_lens.sum())
                     off = np.zeros(len(g_lens) + 1, np.int64)
                     np.cumsum(g_lens, out=off[1:])
@@ -1545,13 +1558,15 @@ class TpuConsensusEngine(Generic[Scope]):
                     )
                     idx_k = np.repeat(g_starts, g_lens) + local
                     rows_k = np.repeat(
-                        np.arange(int(sel.sum()), dtype=np.int64), g_lens
+                        np.arange(int(seg_mask.sum()), dtype=np.int64), g_lens
                     )
                     # Uniform depth d (not g_lens.max()): a shallower final
                     # segment would give its output a different shape,
                     # splitting complete_all's single stacked readback into
                     # two transfers. Pad columns are valid=0, inert.
-                    segs.append((uniq[sel], rows_k, local, d, idx_k, False))
+                    segs.append(
+                        (uniq[seg_mask], rows_k, local, d, idx_k, False)
+                    )
             else:
                 segs.append(
                     (
@@ -1594,7 +1609,7 @@ class TpuConsensusEngine(Generic[Scope]):
                     fresh=fresh_k,
                 )
             )
-            orig_of.append(dev_rows[order[idx_k]])
+            orig_of.append(sel[idx_k])
         with self.tracer.span("engine.device_ingest", votes=int(len(order))):
             results = self._pool.complete_all(pendings)
 
@@ -1615,7 +1630,7 @@ class TpuConsensusEngine(Generic[Scope]):
         # the sorted-domain group index (no re-sort; totals are
         # order-independent).
         sorted_statuses = (
-            statuses[dev_rows[order]] if len(order) else np.empty(0, np.int32)
+            statuses[sel] if len(order) else np.empty(0, np.int32)
         )
         if len(order):
             ok_m = sorted_statuses == int(StatusCode.OK)
