@@ -356,12 +356,16 @@ class TpuConsensusEngine(Generic[Scope]):
         if collisions:
             self.tracer.count("engine.pid_collisions", collisions)
 
-    def _draw_unique_pids(self, scope: Scope, count: int) -> np.ndarray:
+    def _draw_unique_pids(
+        self, existing: np.ndarray, count: int
+    ) -> np.ndarray:
         """Batch id draw: one urandom read, vectorized collision rejection
-        against the scope's live pids and within the batch itself."""
+        against ``existing`` live pids and within the batch itself. Multi-
+        scope creation passes the union of all target scopes' pids and
+        slices one draw per scope — global uniqueness is stronger than the
+        per-scope requirement and costs one pass instead of one per scope."""
         import os as _os
 
-        existing, _ = self._pid_table(scope)
         ids = np.frombuffer(_os.urandom(4 * count), dtype=np.uint32).astype(
             np.int64
         )
@@ -430,19 +434,41 @@ class TpuConsensusEngine(Generic[Scope]):
         spans: list = []
         fallbacks: list = []
         cols = _CreationCols()
+        batched: list[int] = []
         for idx, (scope, requests) in enumerate(items):
             existing = len(self._scopes.get(scope, []))
             if existing + len(requests) > self._max_sessions_per_scope:
                 fallbacks.append(idx)
+            else:
+                batched.append(idx)
+        # Single-host: ONE id draw for the whole call, collision-checked
+        # against the union of every batched scope's live pids, sliced per
+        # scope below (a per-scope draw pays the fixed numpy overhead
+        # len(items) times).
+        pre_ids: dict[int, np.ndarray] = {}
+        if not self._multihost and batched:
+            total = sum(len(items[i][1]) for i in batched)
+            if total:
+                parts = [self._pid_table(items[i][0])[0] for i in batched]
+                all_ids = self._draw_unique_pids(np.concatenate(parts), total)
+                off = 0
+                for i in batched:
+                    k = len(items[i][1])
+                    pre_ids[i] = all_ids[off : off + k]
+                    off += k
+        done = 0
+        for idx, (scope, requests) in enumerate(items):
+            if done < len(batched) and batched[done] == idx:
+                done += 1
+                proposals, configs = self._prepare_creation(
+                    scope, requests, now, config, cols, pre_ids.get(idx)
+                )
+                spans.append((len(entries), len(proposals)))
+                entries.extend(
+                    (scope, p, c) for p, c in zip(proposals, configs)
+                )
+            else:
                 spans.append(None)
-                continue
-            proposals, configs = self._prepare_creation(
-                scope, requests, now, config, cols
-            )
-            spans.append((len(entries), len(proposals)))
-            entries.extend(
-                (scope, p, c) for p, c in zip(proposals, configs)
-            )
         created = self._allocate_and_register(entries, now, cols)
         for idx, span in enumerate(spans):
             if span is not None:
@@ -462,6 +488,7 @@ class TpuConsensusEngine(Generic[Scope]):
         now: int,
         config: ConsensusConfig | None,
         cols: "_CreationCols",
+        pre_ids: np.ndarray | None = None,
     ) -> tuple[list[Proposal], list[ConsensusConfig]]:
         """Python-side prep shared by the batch creators: mint proposals
         with batch-drawn ids (single-host) or deterministic ids (multi-host)
@@ -476,9 +503,14 @@ class TpuConsensusEngine(Generic[Scope]):
         # uniqueness policy as generate_id/regenerate_until_unique, minus
         # the per-proposal uuid4 cost). Multi-host keeps the deterministic
         # per-proposal derivation (_ensure_unique_pid).
-        batch_ids = (
-            None if self._multihost else self._draw_unique_pids(scope, len(requests))
-        )
+        if pre_ids is not None:
+            batch_ids = pre_ids
+        elif self._multihost:
+            batch_ids = None
+        else:
+            batch_ids = self._draw_unique_pids(
+                self._pid_table(scope)[0], len(requests)
+            )
         # Config resolution is identical for requests sharing (expiration,
         # liveness) when no per-proposal override exists — memoize per batch.
         cfg_cache: dict = {}
@@ -1447,11 +1479,36 @@ class TpuConsensusEngine(Generic[Scope]):
         if dev_rows is None or dev_rows.size:
             dslots = slots if dev_rows is None else slots[dev_rows]
             dgids = voter_gids if dev_rows is None else voter_gids[dev_rows]
-            order = np.argsort(dslots, kind="stable")
-            sel = order if dev_rows is None else dev_rows[order]
-            s_sorted = dslots[order]
+            # Grouped-stream fast path: a proposal-major batch (each slot's
+            # rows contiguous, checked as "no slot starts two runs") is
+            # already a valid sorted-domain order — the O(B log B) argsort
+            # and its gathers vanish. Only probed when runs are few (the
+            # run-start values' unique() would itself be a sort otherwise).
+            ordered = False
+            if len(dslots) > 1:
+                run_starts = np.empty(len(dslots), bool)
+                run_starts[0] = True
+                np.not_equal(dslots[1:], dslots[:-1], out=run_starts[1:])
+                n_runs = int(run_starts.sum())
+                if n_runs * 4 <= len(dslots):
+                    start_vals = dslots[run_starts]
+                    ordered = len(np.unique(start_vals)) == n_runs
+            else:
+                ordered = True
+            if ordered:
+                order = np.arange(len(dslots), dtype=np.int64)
+                sel = order if dev_rows is None else dev_rows
+                s_sorted = dslots
+            else:
+                order = np.argsort(dslots, kind="stable")
+                sel = order if dev_rows is None else dev_rows[order]
+                s_sorted = dslots[order]
             uniq, starts_idx, grp_sorted, col_sorted, counts = _group(s_sorted)
-            gid_idx_sorted = voter_gids[sel] & 0xFFFFFFFF
+            # ordered: dgids is already in sorted-domain order — masking it
+            # avoids re-gathering what's in hand.
+            gid_idx_sorted = (
+                dgids & 0xFFFFFFFF if ordered else voter_gids[sel] & 0xFFFFFFFF
+            )
             lanes_sorted = self._pool.fresh_lanes_grouped(
                 s_sorted, gid_idx_sorted, col_sorted, uniq, counts
             )
@@ -1476,7 +1533,11 @@ class TpuConsensusEngine(Generic[Scope]):
                     uniq, starts_idx, grp_sorted, col_sorted, counts = _group(
                         s_sorted
                     )
-            vals_sorted = values[sel]
+            vals_sorted = (
+                values
+                if ordered and dev_rows is None and len(sel) == len(values)
+                else values[sel]
+            )
 
         # Dispatch plan. Preferred: ONE closed-form (scan-free) dispatch for
         # the whole batch — valid exactly when the fast lane path ran (fresh
@@ -1629,9 +1690,15 @@ class TpuConsensusEngine(Generic[Scope]):
         # Round + late-vote bookkeeping per touched slot, via bincount over
         # the sorted-domain group index (no re-sort; totals are
         # order-independent).
-        sorted_statuses = (
-            statuses[sel] if len(order) else np.empty(0, np.int32)
-        )
+        if len(orig_of) == 1 and len(orig_of[0]) == len(order):
+            # Single dispatch covering the whole sorted domain (the fresh
+            # fast path): its output IS the sorted-domain statuses — skip
+            # the O(B) re-gather through statuses.
+            sorted_statuses = results[0][0]
+        else:
+            sorted_statuses = (
+                statuses[sel] if len(order) else np.empty(0, np.int32)
+            )
         if len(order):
             ok_m = sorted_statuses == int(StatusCode.OK)
             if ok_m.any():
