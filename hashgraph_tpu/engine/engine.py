@@ -2396,6 +2396,14 @@ class _PidLookup:
         """Returns (found bool[B], slot int64[B]; 0 where not found)."""
         q = np.asarray(q, np.int64)
         batch = len(q)
+        if batch >= 512:
+            # Fused native probe (one C pass per query, GIL released) —
+            # the numpy loop below pays ~12 array passes per probe round.
+            from .. import native as _native
+
+            res = _native.pid_lookup(self.keys, self.vals, int(self._shift), q)
+            if res is not None:
+                return res
         found = np.zeros(batch, bool)
         out = np.zeros(batch, np.int64)
         # Any int64 key hashes fine (uint64 cast); only -1 must be excluded

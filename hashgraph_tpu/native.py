@@ -97,8 +97,23 @@ def _load() -> ctypes.CDLL | None:
             lib = ctypes.CDLL(path)
         except OSError:
             return None
+        if not hasattr(lib, "hg_pid_lookup"):
+            # Stale pre-v2 artifact (e.g. a cached build from an older
+            # checkout): rebuild the default path once, else give up.
+            if path != _DEFAULT_SO or not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                return None
+            if not hasattr(lib, "hg_pid_lookup"):
+                # dlopen caches by path, so the reload may return the
+                # SAME stale handle; the rebuilt artifact then only takes
+                # effect in a fresh process — degrade, don't crash.
+                return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u64p = ctypes.POINTER(ctypes.c_uint64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
         lib.hg_version.restype = ctypes.c_int
         lib.hg_sha256.argtypes = [u8p, ctypes.c_uint64, u8p]
         lib.hg_keccak256.argtypes = [u8p, ctypes.c_uint64, u8p]
@@ -113,7 +128,11 @@ def _load() -> ctypes.CDLL | None:
         lib.hg_eth_sign.argtypes = [u8p, u8p, ctypes.c_uint64, u8p]
         lib.hg_eth_address.restype = ctypes.c_int
         lib.hg_eth_address.argtypes = [u8p, u8p]
-        if lib.hg_version() != 1:
+        lib.hg_pid_lookup.argtypes = [
+            i64p, i64p, ctypes.c_int64, ctypes.c_int, i64p,
+            ctypes.c_int64, u8p, i64p, ctypes.c_int,
+        ]
+        if lib.hg_version() < 2:
             return None
         _lib = lib
         return _lib
@@ -140,6 +159,44 @@ def keccak256(data: bytes) -> bytes | None:
     out = np.empty(32, np.uint8)
     lib.hg_keccak256(_u8(data), len(data), _np_u8p(out))
     return out.tobytes()
+
+
+def pid_lookup(
+    table_keys: np.ndarray,
+    table_vals: np.ndarray,
+    shift: int,
+    queries: np.ndarray,
+    n_threads: int = 0,
+) -> "tuple[np.ndarray, np.ndarray] | None":
+    """Fused open-addressing probe (engine._PidLookup layout: power-of-two
+    table, Fibonacci bucketing with the given shift, -1 empty sentinel).
+    Returns (found bool[B], slots int64[B]; 0 where not found), or None
+    when the native runtime is absent. The call releases the GIL."""
+    lib = _load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(table_keys, np.int64)
+    vals = np.ascontiguousarray(table_vals, np.int64)
+    q = np.ascontiguousarray(queries, np.int64)
+    if len(keys) < 2:
+        # Empty table (size-1 sentinel-only): shift would be 64, a UB
+        # shift width in C — and nothing can match anyway.
+        return np.zeros(len(q), bool), np.zeros(len(q), np.int64)
+    found = np.empty(len(q), np.uint8)
+    out = np.empty(len(q), np.int64)
+    i64 = ctypes.POINTER(ctypes.c_int64)
+    lib.hg_pid_lookup(
+        keys.ctypes.data_as(i64),
+        vals.ctypes.data_as(i64),
+        len(keys),
+        int(shift),
+        q.ctypes.data_as(i64),
+        len(q),
+        _np_u8p(found),
+        out.ctypes.data_as(i64),
+        n_threads,
+    )
+    return found.view(bool), out
 
 
 def sha256_batch(items: list[bytes], n_threads: int = 0) -> np.ndarray | None:

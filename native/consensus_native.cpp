@@ -1343,6 +1343,47 @@ int hg_eth_address(const uint8_t* priv, uint8_t* addr_out) {
   return 0;
 }
 
-int hg_version() { return 1; }
+// Fused open-addressing probe for the engine's proposal-id -> slot hash
+// (mirror of hashgraph_tpu.engine.engine._PidLookup: Fibonacci bucketing
+// h = (uint64(key) * GOLDEN) >> shift over a power-of-two table with -1
+// as the empty sentinel, linear probing). The numpy probe loop pays ~12
+// full-array passes per probe iteration; this is one fused pass per
+// query at memory bandwidth. Queries equal to -1 (the sentinel) resolve
+// to not-found, as in the Python path. Table load factor <= 0.5
+// guarantees empty buckets, so probing always terminates.
+void hg_pid_lookup(const int64_t* table_keys, const int64_t* table_vals,
+                   int64_t size, int shift, const int64_t* queries,
+                   int64_t count, uint8_t* found, int64_t* out,
+                   int n_threads) {
+  const uint64_t GOLDEN = 0x9E3779B97F4A7C15ull;
+  const uint64_t mask = uint64_t(size - 1);
+  run_parallel(count, n_threads, 4096, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++) {
+      const int64_t q = queries[i];
+      if (q == -1) {
+        found[i] = 0;
+        out[i] = 0;
+        continue;
+      }
+      uint64_t h = (uint64_t(q) * GOLDEN) >> shift;
+      for (;;) {
+        const int64_t k = table_keys[h & mask];
+        if (k == q) {
+          found[i] = 1;
+          out[i] = table_vals[h & mask];
+          break;
+        }
+        if (k == -1) {
+          found[i] = 0;
+          out[i] = 0;
+          break;
+        }
+        h++;
+      }
+    }
+  });
+}
+
+int hg_version() { return 2; }
 
 }  // extern "C"

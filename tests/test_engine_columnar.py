@@ -466,6 +466,35 @@ class TestPidLookup:
         found, out = table.lookup(np.array([0, 1, -1], np.int64))
         assert not found.any()
 
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_native_probe_matches_numpy_probe(self, seed, monkeypatch):
+        """lookup() auto-routes big batches to the native fused probe when
+        the runtime is present; its results must be bit-identical to the
+        numpy probe loop on the same table and queries (hits, misses,
+        negative junk, the -1 sentinel, and negative slot values)."""
+        from hashgraph_tpu import native
+        from hashgraph_tpu.engine.engine import _PidLookup
+
+        if not native.available():
+            pytest.skip("native runtime absent: nothing to compare")
+        rng = np.random.default_rng(40 + seed)
+        n = int(rng.integers(1, 4000))
+        pids = rng.choice(2**32 - 1, size=n, replace=False).astype(np.int64)
+        slots = rng.integers(-50, 10_000, size=n).astype(np.int64)  # spills < 0
+        table = _PidLookup(pids, slots)
+        queries = np.concatenate(
+            [
+                pids[rng.integers(0, n, size=700)],
+                rng.integers(-(2**40), 2**40, size=700),
+                np.array([-1, 0, 2**63 - 1], np.int64),
+            ]
+        )
+        res_auto = table.lookup(queries)  # native when available
+        monkeypatch.setattr(native, "pid_lookup", lambda *a, **k: None)
+        res_np = table.lookup(queries)  # forced numpy fallback
+        assert (res_auto[0] == res_np[0]).all()
+        assert (res_auto[1] == res_np[1]).all()
+
 
 class TestMultiScopeColumnar:
     def test_multi_scope_parity_with_per_scope_calls(self):
