@@ -42,6 +42,7 @@ from ..ops.decide import (
 )
 from ..ops.ingest import (
     fresh_ingest_kernel,
+    fresh_ingest_laneless_kernel,
     group_batch,
     ingest_kernel,
     pack_grid,
@@ -806,31 +807,48 @@ class ProposalPool:
         ``fresh=True`` dispatches the closed-form kernel (no sequential
         scan) — ONLY valid when every touched slot is freshly ACTIVE with
         zero tallies and the batch has no repeated (slot, voter) pair; the
-        engine's fast path establishes exactly that."""
+        engine's fast path establishes exactly that. On >64-lane pools the
+        fresh grid additionally requires (and checks) that every lane is
+        the within-slot arrival index — the fresh assignment rule — so the
+        lane plane need not cross the link at all (laneless uint8 cells,
+        half the uint16 upload)."""
         s_count = len(uniq)
         depth = max(int(depth), 1)
-        voter_grid = np.zeros((s_count, depth), np.int32)
-        valbit = np.zeros((s_count, depth), np.int32)
-        if len(row):
-            voter_grid[row, col] = np.asarray(lanes, np.int32)
-            valbit[row, col] = np.asarray(values, np.int32) | 2  # value | valid
-        # Narrow grid cells to the pool's lane range (uint8/uint16) — the
-        # grid is the dominant upload of every dispatch. The Pallas kernel
-        # keeps the fixed int32 layout it was written against.
-        grid = pack_grid(
-            voter_grid,
-            valbit & 1,
-            valbit >> 1,
-            voter_capacity=None if self._use_pallas else self.voter_capacity,
-        )
+        laneless = fresh and self.voter_capacity > 64 and not self._use_pallas
+        if laneless and len(row):
+            if not np.array_equal(lanes, col):
+                raise ValueError(
+                    "fresh ingest on a >64-lane pool requires lanes == "
+                    "within-slot arrival index (the fresh assignment rule)"
+                )
+            grid = np.zeros((s_count, depth), np.uint8)
+            grid[row, col] = np.asarray(values, np.uint8) | 2  # value|valid
+        elif laneless:
+            grid = np.zeros((s_count, depth), np.uint8)
+        else:
+            voter_grid = np.zeros((s_count, depth), np.int32)
+            valbit = np.zeros((s_count, depth), np.int32)
+            if len(row):
+                voter_grid[row, col] = np.asarray(lanes, np.int32)
+                valbit[row, col] = np.asarray(values, np.int32) | 2
+            # Narrow grid cells to the pool's lane range (uint8/uint16) —
+            # the grid is the dominant upload of every dispatch. The Pallas
+            # kernel keeps the fixed int32 layout it was written against.
+            grid = pack_grid(
+                voter_grid,
+                valbit & 1,
+                valbit >> 1,
+                voter_capacity=None if self._use_pallas else self.voter_capacity,
+            )
 
         expired = self._expiry_host[uniq] <= now
-        dispatch = (
-            self._dispatch_ingest_fresh if fresh else self._dispatch_ingest
-        )
-        out, row_select = dispatch(
-            pack_slots(uniq.astype(np.int32), expired), grid
-        )
+        slot_pack2 = pack_slots(uniq.astype(np.int32), expired)
+        if fresh:
+            out, row_select = self._dispatch_ingest_fresh(
+                slot_pack2, grid, laneless=laneless
+            )
+        else:
+            out, row_select = self._dispatch_ingest(slot_pack2, grid)
         pending = PendingIngest(
             out=out, uniq=uniq, row=row, col=col, row_select=row_select
         )
@@ -1036,12 +1054,15 @@ class ProposalPool:
         )
         return out, np.arange(s_count)
 
-    def _dispatch_ingest_fresh(self, slot_pack, grid_pack):
+    def _dispatch_ingest_fresh(self, slot_pack, grid_pack, laneless=False):
         """Closed-form (scan-free) ingest dispatch for fresh-slot batches —
-        same transfer contract as :meth:`_dispatch_ingest`."""
+        same transfer contract as :meth:`_dispatch_ingest`. ``laneless``
+        grids carry value/valid only (uint8); the kernel reconstructs
+        lanes as the within-slot arrival index."""
         s_count, depth = grid_pack.shape
         bucket_s = _bucket(s_count)
         bucket_l = _bucket(depth, floor=1)
+        kernel = fresh_ingest_laneless_kernel if laneless else fresh_ingest_kernel
         (
             self._state,
             self._yes,
@@ -1049,7 +1070,7 @@ class ProposalPool:
             self._vote_mask,
             self._vote_val,
             out,
-        ) = fresh_ingest_kernel(
+        ) = kernel(
             self._state,
             self._yes,
             self._tot,

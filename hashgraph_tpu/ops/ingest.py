@@ -288,10 +288,18 @@ def fresh_ingest_body(
     gossipsub,
     liveness,
     slot_pack,  # int32[S] packed slot ids + expired flags
-    grid_pack,  # int32[S, L] packed voter/value/valid cells
+    grid_pack,  # packed cells: see `laneless` below
+    *,
+    laneless: bool = False,
 ):
     """Closed-form ingest for FRESH slots: the whole per-slot vote chain in
     one dispatch with NO sequential scan.
+
+    ``laneless=True``: the grid carries only value (bit 0) and valid
+    (bit 1) per cell (uint8); voter lanes are reconstructed on device as
+    the within-slot arrival index — which is exactly what the fresh-path
+    lane assignment rule produces — halving the dominant upload for pools
+    whose lane range doesn't fit uint8 anyway (voter_capacity > 64).
 
     The serial scan in :func:`ingest_body` exists because a vote's fate
     depends on the running state. For a batch the engine has already
@@ -316,10 +324,17 @@ def fresh_ingest_body(
 
     slot_ids = slot_pack & _SLOT_MASK
     expired = ((slot_pack >> _EXPIRED_BIT) & 1).astype(bool)
-    lane_mask, val_bit, valid_bit = grid_layout(grid_pack.dtype)
-    voter_grid = (grid_pack & lane_mask).astype(jnp.int32)
-    val_grid = ((grid_pack >> val_bit) & 1).astype(bool)
-    valid = ((grid_pack >> valid_bit) & 1).astype(bool)
+    if laneless:
+        val_grid = (grid_pack & 1).astype(bool)
+        valid = ((grid_pack >> 1) & 1).astype(bool)
+        voter_grid = jnp.broadcast_to(
+            jnp.arange(depth, dtype=jnp.int32), (s_count, depth)
+        )
+    else:
+        lane_mask, val_bit, valid_bit = grid_layout(grid_pack.dtype)
+        voter_grid = (grid_pack & lane_mask).astype(jnp.int32)
+        val_grid = ((grid_pack >> val_bit) & 1).astype(bool)
+        valid = ((grid_pack >> valid_bit) & 1).astype(bool)
 
     gather = lambda arr: jnp.take(arr, slot_ids, axis=0, mode="clip")
     row_n = gather(n)[:, None]
@@ -427,3 +442,6 @@ def fresh_ingest_body(
 fresh_ingest_kernel = partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))(
     fresh_ingest_body
 )
+fresh_ingest_laneless_kernel = partial(
+    jax.jit, donate_argnums=(0, 1, 2, 3, 4)
+)(partial(fresh_ingest_body, laneless=True))

@@ -205,12 +205,17 @@ class MultiHostPool(ShardedPool):
             slot_pack, grid_pack, self._sharded_ingest
         )
 
-    def _dispatch_ingest_fresh(self, slot_pack, grid_pack):
+    def _dispatch_ingest_fresh(self, slot_pack, grid_pack, laneless=False):
         """Fleet closed-form ingest: same shape-agreement + routing as the
         scan dispatch (the caller — the engine — has already agreed
-        fleet-wide that this call takes the fresh path)."""
+        fleet-wide that this call takes the fresh path; the laneless flag
+        derives from voter_capacity, identical on every process)."""
         return self._fleet_routed_ingest(
-            slot_pack, grid_pack, self._sharded_fresh_ingest
+            slot_pack,
+            grid_pack,
+            self._sharded_fresh_ingest_laneless
+            if laneless
+            else self._sharded_fresh_ingest,
         )
 
     def _fleet_routed_ingest(self, slot_pack, grid_pack, kernel):

@@ -164,6 +164,14 @@ class ShardedPool(ProposalPool):
             ),
             donate_argnums=(0, 1, 2, 3, 4),
         )
+        self._sharded_fresh_ingest_laneless = jax.jit(
+            sm(
+                partial(fresh_ingest_body, laneless=True),
+                in_specs=(v1, v1, v1, v2, v2, v1, v1, v1, v1, v1, v1, v2),
+                out_specs=(v1, v1, v1, v2, v2, v2),
+            ),
+            donate_argnums=(0, 1, 2, 3, 4),
+        )
         self._sharded_timeout = jax.jit(
             sm(
                 timeout_body,
@@ -323,11 +331,15 @@ class ShardedPool(ProposalPool):
         )
         return out, rows - row_offset * bucket
 
-    def _dispatch_ingest_fresh(self, slot_pack, grid_pack):
+    def _dispatch_ingest_fresh(self, slot_pack, grid_pack, laneless=False):
         """Sharded closed-form ingest; same routing contract as
         :meth:`_dispatch_ingest`."""
         return self._routed_ingest(
-            slot_pack, grid_pack, self._sharded_fresh_ingest
+            slot_pack,
+            grid_pack,
+            self._sharded_fresh_ingest_laneless
+            if laneless
+            else self._sharded_fresh_ingest,
         )
 
     def _dispatch_timeout(self, slots) -> np.ndarray:

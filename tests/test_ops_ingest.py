@@ -331,6 +331,82 @@ class TestIngestParity:
             assert (pool_scan[key] == pool_fresh[key]).all(), key
 
     @pytest.mark.parametrize("seed", range(4))
+    def test_fresh_laneless_parity(self, seed):
+        """The laneless fresh kernel (value/valid-only uint8 grid, lanes
+        reconstructed on device as the within-slot arrival index) must be
+        bit-identical to the lane-ful fresh kernel when lanes == col —
+        the exact precondition >64-lane pools enforce before using it."""
+        from hashgraph_tpu.ops.ingest import (
+            fresh_ingest_kernel,
+            fresh_ingest_laneless_kernel,
+            group_batch,
+            pack_slots,
+        )
+
+        rng = np.random.default_rng(7100 + seed)
+        configs = []
+        for _ in range(8):
+            n = int(rng.integers(1, 13))
+            mode = "gossipsub" if rng.random() < 0.5 else "p2p"
+            configs.append(
+                (n, mode, bool(rng.random() < 0.5),
+                 float(rng.choice([2 / 3, 1.0])), int(rng.choice([5, 1000])))
+            )
+        trace = []
+        for slot in range(len(configs)):
+            for _ in range(int(rng.integers(0, V_CAP + 1))):
+                trace.append((slot, bool(rng.random() < 0.5)))
+        rng.shuffle(trace)
+        if not trace:
+            trace = [(0, True)]
+        slots = np.array([t[0] for t in trace])
+        vals = np.array([t[1] for t in trace], bool)
+        s_arr = np.asarray(slots, np.int64)
+        uniq, row, col, depth = group_batch(s_arr)
+        # Lanes = within-slot arrival index: the fresh assignment rule,
+        # and the laneless kernel's reconstruction.
+        voters = col.astype(np.int32)
+
+        pool_l, _ = make_pool(configs)
+        st_lane = run_ingest(
+            pool_l, slots, voters, vals, NOW + 6, kernel=fresh_ingest_kernel
+        )
+        # Laneless: same grouping, but the grid carries value|valid only.
+        pool_n, _ = make_pool(configs)
+        grid = np.zeros((len(uniq), depth), np.uint8)
+        grid[row, col] = vals.astype(np.uint8) | 2
+        import jax.numpy as jnp
+
+        out = fresh_ingest_laneless_kernel(
+            jnp.asarray(pool_n["state"]),
+            jnp.asarray(pool_n["yes"]),
+            jnp.asarray(pool_n["tot"]),
+            jnp.asarray(pool_n["vote_mask"]),
+            jnp.asarray(pool_n["vote_val"]),
+            jnp.asarray(pool_n["n"]),
+            jnp.asarray(pool_n["req"]),
+            jnp.asarray(pool_n["cap"]),
+            jnp.asarray(pool_n["gossip"]),
+            jnp.asarray(pool_n["liveness"]),
+            jnp.asarray(
+                pack_slots(
+                    uniq.astype(np.int32),
+                    pool_n["expiry"][uniq] <= NOW + 6,
+                )
+            ),
+            jnp.asarray(grid),
+        )
+        state, yes, tot, vote_mask, vote_val, packed = map(np.asarray, out)
+        pool_n.update(
+            state=state, yes=yes, tot=tot,
+            vote_mask=vote_mask, vote_val=vote_val,
+        )
+        st_laneless = packed[:, :-1][row, col]
+        assert st_lane.tolist() == st_laneless.tolist()
+        for key in ("state", "yes", "tot", "vote_mask", "vote_val"):
+            assert (pool_l[key] == pool_n[key]).all(), key
+
+    @pytest.mark.parametrize("seed", range(4))
     @pytest.mark.parametrize("cap_hint", [16, 4096, None])
     def test_grid_dtype_parity(self, seed, cap_hint):
         """Narrow packed grids (uint8 for capacity<=64, uint16 for <=16384)

@@ -71,3 +71,84 @@ class TestPipelineDiscipline:
             )
         pool.complete(pending)
         pool.timeout([0])  # allowed again once drained
+
+
+class TestLanelessFreshDispatch:
+    """>64-lane pools ship fresh grids without the lane plane (uint8
+    value/valid cells; lanes reconstructed on device as the within-slot
+    arrival index). These tests drive the POOL dispatch layer — the
+    gating, the lanes==col guard, and the sharded laneless kernel — not
+    just the ops-level kernel."""
+
+    def _wide_pool(self, pool_cls=ProposalPool, p=12, v=96, **kw):
+        pool = pool_cls(p, v, **kw) if kw else pool_cls(p, v)
+        pool.allocate_batch(
+            keys=[("s", i) for i in range(pool.capacity)],
+            n=np.full(pool.capacity, v),
+            req=required_votes_np(np.full(pool.capacity, v), 2.0 / 3.0),
+            cap=np.full(pool.capacity, v + 1),
+            gossip=np.zeros(pool.capacity, bool),
+            liveness=np.ones(pool.capacity, bool),
+            expiry=np.full(pool.capacity, NOW + 100),
+            created_at=np.full(pool.capacity, NOW),
+        )
+        return pool
+
+    def _grouped_batch(self, pool, depth):
+        p = pool.capacity
+        uniq = np.arange(p, dtype=np.int64)
+        rows = np.repeat(uniq, depth)
+        cols = np.tile(np.arange(depth, dtype=np.int64), p)
+        vals = (np.arange(p * depth) % 3 != 0).astype(bool)
+        return uniq, rows, cols, cols.astype(np.int32), vals
+
+    def test_laneless_matches_scan_on_wide_pool(self):
+        depth = 80  # > 64 lanes used, exercising the wide-lane range
+        pool_a = self._wide_pool()
+        uniq, rows, cols, lanes, vals = self._grouped_batch(pool_a, depth)
+        pa = pool_a.ingest_async_grouped(
+            uniq, rows, cols, depth, lanes, vals, NOW, fresh=True
+        )
+        (st_a, tr_a), = pool_a.complete_all([pa])
+        pool_b = self._wide_pool()
+        pb = pool_b.ingest_async_grouped(
+            uniq, rows, cols, depth, lanes, vals, NOW, fresh=False
+        )
+        (st_b, tr_b), = pool_b.complete_all([pb])
+        assert st_a.tolist() == st_b.tolist()
+        assert sorted(tr_a) == sorted(tr_b)
+
+    def test_laneless_guard_rejects_non_arrival_lanes(self):
+        pool = self._wide_pool()
+        depth = 4
+        uniq, rows, cols, lanes, vals = self._grouped_batch(pool, depth)
+        with pytest.raises(ValueError, match="arrival index"):
+            pool.ingest_async_grouped(
+                uniq, rows, cols, depth, lanes[::-1].copy(), vals, NOW,
+                fresh=True,
+            )
+
+    def test_sharded_laneless_matches_single_device(self):
+        import jax
+
+        from hashgraph_tpu.parallel.sharded import ShardedPool
+
+        depth = 70
+        n_dev = len(jax.devices())
+        p = 2 * n_dev  # 2 slots per device, any mesh size
+        single = self._wide_pool(p=p)
+        uniq, rows, cols, lanes, vals = self._grouped_batch(single, depth)
+        ps = single.ingest_async_grouped(
+            uniq, rows, cols, depth, lanes, vals, NOW, fresh=True
+        )
+        (st_s, _), = single.complete_all([ps])
+
+        sharded = self._wide_pool(
+            pool_cls=lambda cap, v: ShardedPool(cap // n_dev, v), p=p
+        )
+        assert sharded.capacity == single.capacity
+        pd = sharded.ingest_async_grouped(
+            uniq, rows, cols, depth, lanes, vals, NOW, fresh=True
+        )
+        (st_d, _), = sharded.complete_all([pd])
+        assert st_s.tolist() == st_d.tolist()
