@@ -97,16 +97,17 @@ def _load() -> ctypes.CDLL | None:
             lib = ctypes.CDLL(path)
         except OSError:
             return None
-        if not hasattr(lib, "hg_pid_lookup"):
-            # Stale pre-v2 artifact (e.g. a cached build from an older
-            # checkout): rebuild the default path once, else give up.
+        _NEWEST_SYMBOL = "hg_gids_live"  # bump when the ABI grows
+        if not hasattr(lib, _NEWEST_SYMBOL):
+            # Stale artifact (e.g. a cached build from an older checkout):
+            # rebuild the default path once, else give up.
             if path != _DEFAULT_SO or not _build():
                 return None
             try:
                 lib = ctypes.CDLL(path)
             except OSError:
                 return None
-            if not hasattr(lib, "hg_pid_lookup"):
+            if not hasattr(lib, _NEWEST_SYMBOL):
                 # dlopen caches by path, so the reload may return the
                 # SAME stale handle; the rebuilt artifact then only takes
                 # effect in a fresh process — degrade, don't crash.
@@ -131,6 +132,10 @@ def _load() -> ctypes.CDLL | None:
         lib.hg_pid_lookup.argtypes = [
             i64p, i64p, ctypes.c_int64, ctypes.c_int, i64p,
             ctypes.c_int64, u8p, i64p, ctypes.c_int,
+        ]
+        lib.hg_gids_live.argtypes = [
+            i64p, ctypes.c_int64, u8p, i64p,
+            ctypes.c_int64, u8p, ctypes.c_int,
         ]
         if lib.hg_version() < 2:
             return None
@@ -197,6 +202,39 @@ def pid_lookup(
         n_threads,
     )
     return found.view(bool), out
+
+
+def gids_live(
+    gids: np.ndarray,
+    live: np.ndarray,
+    gen: np.ndarray,
+    n_threads: int = 0,
+) -> "np.ndarray | None":
+    """Fused generation-tagged gid liveness check (pool.gids_live layout):
+    bool[B], or None when the runtime is absent."""
+    lib = _load()
+    if lib is None:
+        return None
+    g = np.ascontiguousarray(gids, np.int64)
+    # bool and uint8 share layout: view, don't copy the whole registry.
+    lv = (
+        live.view(np.uint8)
+        if live.dtype == np.bool_ and live.flags.c_contiguous
+        else np.ascontiguousarray(live, np.uint8)
+    )
+    gn = np.ascontiguousarray(gen, np.int64)
+    out = np.empty(len(g), np.uint8)
+    i64 = ctypes.POINTER(ctypes.c_int64)
+    lib.hg_gids_live(
+        g.ctypes.data_as(i64),
+        len(g),
+        _np_u8p(lv),
+        gn.ctypes.data_as(i64),
+        len(gn),
+        _np_u8p(out),
+        n_threads,
+    )
+    return out.view(bool)
 
 
 def sha256_batch(items: list[bytes], n_threads: int = 0) -> np.ndarray | None:

@@ -9,6 +9,7 @@ population, not the historical one.
 """
 
 import numpy as np
+import pytest
 
 from hashgraph_tpu import CreateProposalRequest, StubConsensusSigner, build_vote
 from hashgraph_tpu.engine import ProposalPool, TpuConsensusEngine
@@ -79,6 +80,48 @@ class TestStaleGids:
         pool.release([slot])
         # Freed id: live mask flips off even though the id is range-valid.
         assert pool.gids_live(np.array([gid])).tolist() == [False]
+
+    def test_gids_live_native_matches_numpy(self):
+        """The native fused liveness pass (auto-routed for batches >= 512)
+        must agree with the numpy path on live, freed, recycled-generation,
+        out-of-range, negative, and sentinel gids."""
+        from hashgraph_tpu import native
+
+        if not native.available():
+            pytest.skip("native runtime absent: nothing to compare")
+        rng = np.random.default_rng(77)
+        pool = ProposalPool(8, 8)
+        pool.allocate_batch(
+            [("s", i) for i in range(8)], n=np.full(8, 8),
+            req=np.full(8, 8), cap=np.full(8, 2),
+            gossip=np.ones(8, bool), liveness=np.ones(8, bool),
+            expiry=np.full(8, NOW + 100), created_at=np.full(8, NOW),
+        )
+        owner = lambda i: (i + 1).to_bytes(2, "little") * 10
+        gids = np.array([pool.voter_gid(owner(i)) for i in range(120)])
+        pool.lanes_for_batch(np.arange(40, dtype=np.int64) % 8, gids[:40])
+        pool.release(list(range(8)))  # evicts the 40 referenced voters
+        recycled = np.array([pool.voter_gid(owner(i)) for i in range(5)])
+        qs = np.concatenate(
+            [
+                gids, recycled,
+                np.array([-1, -9, 2**40, (1 << 33) | 3], np.int64),
+                rng.integers(-(2**35), 2**35, 600),
+            ]
+        )
+        assert len(qs) >= 512  # native-routed
+        whole = pool.gids_live(qs)
+        chunked = np.concatenate(  # forced numpy (below threshold)
+            [pool.gids_live(qs[i : i + 128]) for i in range(0, len(qs), 128)]
+        )
+        assert (whole == chunked).all()
+        # And with the native layer explicitly absent, same answer.
+        orig = native.gids_live
+        try:
+            native.gids_live = lambda *a, **k: None
+            assert (pool.gids_live(qs) == whole).all()
+        finally:
+            native.gids_live = orig
 
     def test_columnar_rejects_stale_gid_after_eviction(self):
         """A gid held across a release boundary must get a typed rejection,
