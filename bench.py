@@ -17,8 +17,11 @@ BASELINE shape:
                100k-proposal north star)
 
 Individual runs via argv: engine | pool (alias config3) | config2 |
-config4 | config5 | lanes1024 | crypto | validated | default | all
-(``all`` prints newline-separated JSON, one line per section).
+config4 | config5 | lanes1024 | crypto | validated | wal | default | all
+(``all`` prints newline-separated JSON, one line per section). ``wal``
+measures the durability subsystem: append throughput per fsync policy,
+DurableEngine ingest overhead vs a bare engine, and recovery replay rate
+(host-only — not part of the BASELINE sweep).
 
 Traces are pre-validated replays (signature/hash verification is the
 pluggable host stage — measured separately by ``python bench.py crypto``
@@ -1135,6 +1138,137 @@ def run_deepchain(
     }
 
 
+def run_wal(
+    p_count: int = 256,
+    voters_per_proposal: int = 12,
+    wave: int = 512,
+    raw_records: int = 2_000,
+) -> dict:
+    """Durability subsystem overhead: WAL append throughput per fsync
+    policy, engine vote-ingest bare vs DurableEngine-wrapped, and crash
+    recovery replay rate. Host-only (filesystem + engine scalar surface);
+    runs identically under JAX_PLATFORMS=cpu.
+
+    The headline is the wrapped/bare ingest ratio at the "batch" policy —
+    the number an embedder pays for durability on the hot path. "always"
+    appends are fsync-bound and measured on a smaller count.
+    """
+    import os
+    import tempfile
+
+    from hashgraph_tpu import CreateProposalRequest, StubConsensusSigner, build_vote
+    from hashgraph_tpu.engine import TpuConsensusEngine
+    from hashgraph_tpu.wal import DurableEngine, WalWriter, replay
+    from hashgraph_tpu.wal import format as WF
+
+    def fresh_engine(identity: bytes) -> TpuConsensusEngine:
+        return TpuConsensusEngine(
+            StubConsensusSigner(identity),
+            capacity=max(512, p_count * 2),
+            voter_capacity=64,
+        )
+
+    now = 1_700_000_000
+    identity = os.urandom(20)
+    scope = "bench"
+
+    # Workload: p_count proposals, each voted on by voters_per_proposal
+    # distinct remote voters (pre-validated replay, same convention as the
+    # BASELINE configs), delivered in waves through ingest_votes.
+    requests = [
+        CreateProposalRequest(
+            name=f"b{i}",
+            payload=b"x",
+            proposal_owner=b"owner",
+            expected_voters_count=voters_per_proposal + 1,
+            expiration_timestamp=10_000,
+            liveness_criteria_yes=True,
+        )
+        for i in range(p_count)
+    ]
+    signers = [StubConsensusSigner(os.urandom(20)) for _ in range(voters_per_proposal)]
+
+    def build_workload(engine):
+        proposals = engine.create_proposals(scope, requests, now)
+        votes = [
+            (scope, build_vote(p, True, s, now + 1))
+            for p in proposals
+            for s in signers
+        ]
+        return [votes[i : i + wave] for i in range(0, len(votes), wave)]
+
+    def timed_ingest(engine):
+        waves = build_workload(engine)
+        total = sum(len(w) for w in waves)
+        t0 = time.perf_counter()
+        for batch in waves:
+            engine.ingest_votes(batch, now + 2, pre_validated=True)
+        return total, time.perf_counter() - t0
+
+    # Warm the jit cache on a throwaway engine so neither timed side pays
+    # first-call compilation (the workload shapes are identical).
+    timed_ingest(fresh_engine(identity))
+
+    detail = {}
+    with tempfile.TemporaryDirectory() as root:
+        # Raw append throughput per policy (vote-record-sized payloads).
+        sample = build_vote(
+            fresh_engine(identity).create_proposal(scope, requests[0], now),
+            True,
+            signers[0],
+            now,
+        ).encode()
+        payload = WF.encode_votes(now, True, [(scope, sample)] * 4)
+        for policy, count in (
+            ("off", raw_records),
+            ("batch", raw_records),
+            ("always", max(64, raw_records // 20)),
+        ):
+            with WalWriter(
+                os.path.join(root, f"raw-{policy}"), fsync_policy=policy
+            ) as wal:
+                t0 = time.perf_counter()
+                for _ in range(count):
+                    wal.append(WF.KIND_VOTES, payload)
+                dt = time.perf_counter() - t0
+            detail[f"append_{policy}_records_per_sec"] = round(count / dt)
+            detail[f"append_{policy}_mb_per_sec"] = round(
+                count * (len(payload) + WF.HEADER_BYTES + WF.BODY_LEAD_BYTES)
+                / dt
+                / 1e6,
+                1,
+            )
+
+        # Engine ingest: bare vs wrapped (batch policy — the default).
+        bare_votes, bare_dt = timed_ingest(fresh_engine(identity))
+        wal_dir = os.path.join(root, "engine")
+        durable = DurableEngine(
+            fresh_engine(identity), wal_dir, fsync_policy="batch"
+        )
+        wrapped_votes, wrapped_dt = timed_ingest(durable)
+        durable.close()
+        bare_rate = bare_votes / bare_dt
+        wrapped_rate = wrapped_votes / wrapped_dt
+        detail["ingest_bare_votes_per_sec"] = round(bare_rate)
+        detail["ingest_durable_votes_per_sec"] = round(wrapped_rate)
+
+        # Recovery: replay the log just written into a fresh engine.
+        recovered = fresh_engine(identity)
+        t0 = time.perf_counter()
+        stats = replay(wal_dir, recovered)
+        dt = time.perf_counter() - t0
+        detail["recover_records_per_sec"] = round(stats.records_applied / dt)
+        detail["recover_votes_per_sec"] = round(stats.votes_replayed / dt)
+        detail["recover_records"] = stats.records_applied
+
+    return {
+        "metric": "wal_durable_vs_bare_ingest",
+        "value": round(wrapped_rate / bare_rate, 3),
+        "unit": "ratio",
+        "detail": detail,
+    }
+
+
 def run_default() -> dict:
     """The driver-visible sweep: engine-level config 3 as the headline,
     every other BASELINE shape in ``detail`` (one JSON line total).
@@ -1204,6 +1338,7 @@ if __name__ == "__main__":
         "deepchain": run_deepchain,
         "crypto": run_crypto,
         "validated": run_validated,
+        "wal": run_wal,
         "default": run_default,
     }
     if which == "all":

@@ -70,6 +70,7 @@ from .types import (
     CreateProposalRequest,
     SessionTransition,
 )
+from .wal import DurableEngine, WalWriter
 from .wire import Proposal, Vote
 
 __version__ = "0.1.0"
@@ -77,6 +78,8 @@ __version__ = "0.1.0"
 __all__ = [
     "Proposal",
     "Vote",
+    "DurableEngine",
+    "WalWriter",
     "ConsensusService",
     "ConsensusStats",
     "ConsensusConfig",

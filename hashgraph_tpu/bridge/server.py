@@ -59,12 +59,32 @@ class BridgeServer:
         capacity: int = 256,
         voter_capacity: int = 16,
         engine_factory=None,
+        wal_dir: str | None = None,
+        wal_fsync: str = "batch",
     ):
         self._host = host
         self._port = port
         self._capacity = capacity
         self._voter_capacity = voter_capacity
         self._engine_factory = engine_factory
+        # Durability: with a wal_dir every peer's engine is wrapped in a
+        # DurableEngine logging each incoming wire message BEFORE its ack
+        # frame is sent (the response is only written after the handler —
+        # and therefore the WAL append — returns). Peer logs are keyed by
+        # signer identity, which is stable across restarts for key-carrying
+        # ADD_PEER calls, so re-adding the same key replays the peer's log.
+        self._wal_dir = wal_dir
+        self._wal_fsync = wal_fsync
+        # identity -> live DurableEngine for this run: one WalWriter per
+        # directory, ever. Re-adding a key reuses the open engine instead
+        # of opening a second writer on the same segment files (which
+        # would interleave duplicate LSNs and corrupt watermark skipping
+        # on the next restart). _durable_gates serializes same-identity
+        # creation without holding the server-wide lock through recovery;
+        # _recovery keeps each identity's ReplayStats for the embedder.
+        self._durable: dict[bytes, object] = {}
+        self._durable_gates: dict[bytes, threading.Lock] = {}
+        self._recovery: dict[bytes, object] = {}
         self._peers: dict[int, _Peer] = {}
         self._next_peer = 1
         self._lock = threading.Lock()
@@ -122,6 +142,27 @@ class BridgeServer:
             handlers = list(self._handlers)
         for thread in handlers:
             thread.join(timeout=5)
+        # Flush + close the per-identity WALs, then evict those engines and
+        # the peers built on them: a closed WalWriter can never append
+        # again, so a restarted server must rebuild each durable engine
+        # (re-recovering from its log on the next ADD_PEER) rather than
+        # hand out the closed one. Undecorated engines hold no file
+        # handles; their peers survive a stop()/start() cycle unchanged.
+        with self._lock:
+            durable = list(self._durable.values())
+            self._durable.clear()
+            # Stats and gates die with the engines they described: a stale
+            # ReplayStats surviving into the next start() would report a
+            # previous incarnation's recovery as the current one's.
+            self._recovery.clear()
+            self._durable_gates.clear()
+            closed = {id(engine) for engine in durable}
+            for peer_id in [
+                pid for pid, p in self._peers.items() if id(p.engine) in closed
+            ]:
+                del self._peers[peer_id]
+        for engine in durable:
+            engine.close()
 
     def __enter__(self) -> "BridgeServer":
         self.start()
@@ -208,22 +249,100 @@ class BridgeServer:
             signer = EthereumConsensusSigner(c.raw(32))
         else:
             return P.STATUS_BAD_REQUEST, P.string("key must be absent or 32 bytes")
-        if self._engine_factory is not None:
-            engine = self._engine_factory(signer)
+        identity = signer.identity()
+        # Durability only for key-carrying peers: a keyless ADD_PEER mints a
+        # random signer whose identity can never be presented again, so its
+        # WAL could never be replayed — wrapping it would only accumulate
+        # one dead per-identity directory (plus fsync cost) per ephemeral
+        # peer. Keyless peers run undurable by construction.
+        if self._wal_dir is not None and keylen == 32:
+            engine = self._durable_engine(signer, identity)
         else:
-            engine = TpuConsensusEngine(
-                signer,
-                event_bus=BroadcastEventBus(),
-                capacity=self._capacity,
-                voter_capacity=self._voter_capacity,
-            )
+            engine = self._build_engine(signer)
         receiver = engine.event_bus().subscribe()
         with self._lock:
+            # stop()'s sweep only evicts peers it can SEE: a registration
+            # that lands after the sweep would pin a closed durable engine
+            # into the next start(). Refuse instead — the engine itself is
+            # either undurable (no handles) or still published in _durable,
+            # where the sweep closes it.
+            if not self._running:
+                raise ValueError("server is stopping")
             peer_id = self._next_peer
             self._next_peer += 1
             self._peers[peer_id] = _Peer(peer_id, engine, receiver)
-        identity = signer.identity()
         return P.STATUS_OK, P.u32(peer_id) + P.u8(len(identity)) + identity
+
+    def _build_engine(self, signer):
+        if self._engine_factory is not None:
+            return self._engine_factory(signer)
+        return TpuConsensusEngine(
+            signer,
+            event_bus=BroadcastEventBus(),
+            capacity=self._capacity,
+            voter_capacity=self._voter_capacity,
+        )
+
+    def _durable_engine(self, signer, identity: bytes):
+        """Create-or-reuse the durable engine for ``identity``. A
+        per-identity gate serializes concurrent ADD_PEERs with the same key
+        (two WalWriters on one directory would interleave duplicate LSNs)
+        while keeping WAL replay — potentially seconds for a large log —
+        off the server-wide lock, so other connections and ADD_PEERs
+        proceed during one peer's recovery."""
+        import os
+
+        from ..wal import DurableEngine
+
+        with self._lock:
+            gate = self._durable_gates.setdefault(identity, threading.Lock())
+        with gate:
+            with self._lock:
+                # Same guard as the publish below: once stop() begins, its
+                # sweep owns every published durable engine (and closes
+                # it); handing one out here would let a racing ADD_PEER
+                # register a peer on an engine that is about to close.
+                if not self._running:
+                    raise ValueError("server is stopping")
+                engine = self._durable.get(identity)
+            if engine is not None:
+                return engine
+            engine = DurableEngine(
+                self._build_engine(signer),
+                os.path.join(self._wal_dir, "peer-" + identity.hex()),
+                fsync_policy=self._wal_fsync,
+            )
+            # Crash recovery before the peer serves traffic: replay any
+            # surviving log from a previous run of this identity. The event
+            # subscription happens after, so replayed transitions don't
+            # re-surface through OP_POLL_EVENTS. The stats are retained
+            # (see recovery_stats) because nonzero segments_dropped /
+            # errors means acknowledged records could not be replayed —
+            # the embedder should be told, not served silently partial
+            # state; replay() itself emits the wal.recover.* counters.
+            stats = engine.recover()
+            with self._lock:
+                # A handler that outlived stop()'s join (recovery of a big
+                # log can exceed the 5s timeout) must not publish after the
+                # shutdown sweep already cleared _durable — the engine
+                # would leak an open WalWriter (flock held until process
+                # exit) and its peer could still mutate state after stop()
+                # returned. Close and refuse instead.
+                if not self._running:
+                    engine.close()
+                    raise ValueError("server is stopping")
+                self._recovery[identity] = stats
+                self._durable[identity] = engine
+            return engine
+
+    def recovery_stats(self, identity: bytes):
+        """:class:`~hashgraph_tpu.wal.ReplayStats` from the WAL recovery
+        that backed ``identity``'s engine (None = identity unknown or not
+        durable). Nonzero ``segments_dropped`` or ``errors`` means mid-log
+        corruption: acknowledged records exist that replay could not
+        reproduce."""
+        with self._lock:
+            return self._recovery.get(identity)
 
     def _op_create_proposal(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
         scope = c.string()

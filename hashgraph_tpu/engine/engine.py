@@ -61,7 +61,7 @@ from ..types import (
     ConsensusReached,
     CreateProposalRequest,
 )
-from ..wire import Proposal, Vote
+from ..wire import Proposal, Vote, normalize_wire_votes
 from .pool import ProposalPool
 from .session_sync import allocate_slot, load_session_rows, state_code_of
 
@@ -260,10 +260,9 @@ class TpuConsensusEngine(Generic[Scope]):
         # Fused multi-scope resolution cache: one composite-key hash per
         # distinct scope tuple of an ingest_columnar_multi call (small
         # bounded dict, so alternating scope orders don't thrash a single
-        # slot). The epoch counter advances on ANY scope's membership
-        # change, clearing every fused table without tracking which scopes
-        # each one spans.
-        self._pid_epoch = 0
+        # slot). ANY scope's membership change clears the whole cache
+        # outright (_drop_pid_cache) — cheaper than tracking which scopes
+        # each tuple spans, and rebuilds are one vectorized pass.
         self._fused_pid_cache: dict[tuple, "_PidLookup"] = {}
 
     # ── Accessors ──────────────────────────────────────────────────────
@@ -661,15 +660,22 @@ class TpuConsensusEngine(Generic[Scope]):
         return [p.clone() for _, p, _ in entries]
 
     def process_incoming_proposal(
-        self, scope: Scope, proposal: Proposal, now: int
+        self,
+        scope: Scope,
+        proposal: Proposal,
+        now: int,
+        config: ConsensusConfig | None = None,
     ) -> None:
         """Validate a network proposal (signatures, chain, expiry — the full
         scalar gauntlet, reference: src/session.rs:198-221) and load the
         replayed session into the pool as a dense row (resume-from-snapshot).
+        ``config`` optionally overrides the scope-config resolution with the
+        same precedence create_proposal gives its explicit override — WAL
+        replay uses this to preserve a logged override across recovery.
         """
         if (scope, proposal.proposal_id) in self._index:
             raise ProposalAlreadyExist()
-        config = self._resolve_config(scope, None, proposal)
+        config = self._resolve_config(scope, config, proposal)
         # The scalar oracle replays embedded votes with exact reference
         # semantics (chain validation, per-vote ECDSA, round caps); the dense
         # row is loaded from its final state.
@@ -689,7 +695,10 @@ class TpuConsensusEngine(Generic[Scope]):
         self._register_session(scope, session, now)
 
     def ingest_proposals(
-        self, items: list[tuple[Scope, Proposal]], now: int
+        self,
+        items: list[tuple[Scope, Proposal]],
+        now: int,
+        configs: "list[ConsensusConfig | None] | None" = None,
     ) -> list[int]:
         """Batch counterpart of process_incoming_proposal: validate and load
         many (possibly vote-carrying) proposals in bulk.
@@ -700,10 +709,15 @@ class TpuConsensusEngine(Generic[Scope]):
         each proposal replays the exact scalar check sequence with the
         precomputed verdicts injected, so error precedence is identical to
         the scalar path. Returns one StatusCode per item (OK = registered;
-        events emitted exactly as the scalar path would).
+        events emitted exactly as the scalar path would). ``configs``
+        optionally supplies a per-item explicit config override (same
+        precedence as create_proposal's; None entries resolve from the
+        scope config) — WAL replay uses it to preserve logged overrides.
         """
         from ..ops.chain import chain_kernel_batch, first_chain_error, pack_chain
 
+        if configs is not None and len(configs) != len(items):
+            raise ValueError("configs must supply one entry per item")
         statuses = [int(StatusCode.OK)] * len(items)
 
         # Bulk signature verification across every embedded vote.
@@ -756,7 +770,9 @@ class TpuConsensusEngine(Generic[Scope]):
                 continue
             start, count = spans[i]
             try:
-                config = self._resolve_config(scope, None, proposal)
+                config = self._resolve_config(
+                    scope, configs[i] if configs is not None else None, proposal
+                )
                 session, transition = ConsensusSession.from_proposal(
                     proposal.clone(),
                     self._scheme,
@@ -1208,29 +1224,8 @@ class TpuConsensusEngine(Generic[Scope]):
         """Validate and normalize wire_votes to (u8 data, i64 offsets)
         BEFORE any state mutates — a malformed argument must fail the call,
         not strand already-applied votes without their retained bytes."""
-        if isinstance(wire_votes, tuple):
-            data, offsets = wire_votes
-            data_arr = (
-                np.frombuffer(data, np.uint8)
-                if isinstance(data, (bytes, bytearray, memoryview))
-                else np.asarray(data, np.uint8)
-            )
-            offsets = np.asarray(offsets, np.int64)
-        else:
-            data_arr = np.frombuffer(b"".join(wire_votes), np.uint8)
-            offsets = np.zeros(len(wire_votes) + 1, np.int64)
-            np.cumsum([len(b) for b in wire_votes], out=offsets[1:])
-        if len(offsets) != batch + 1:
-            raise ValueError("wire_votes must supply one entry per batch row")
-        if len(offsets) and int(offsets[-1]) > len(data_arr):
-            raise ValueError("wire_votes offsets exceed the packed data")
-        if len(offsets) and (
-            int(offsets[0]) < 0 or (np.diff(offsets) < 0).any()
-        ):
-            raise ValueError(
-                "wire_votes offsets must be non-negative and non-decreasing"
-            )
-        return data_arr, offsets
+        blob, offsets = normalize_wire_votes(wire_votes, batch)
+        return np.frombuffer(blob, np.uint8), offsets
 
     def _retain_wire_slots(
         self,
@@ -1738,10 +1733,10 @@ class TpuConsensusEngine(Generic[Scope]):
 
     def _drop_pid_cache(self, scope: Scope) -> None:
         """Invalidate pid-resolution caches after a membership change in
-        ``scope`` (register/evict/delete)."""
+        ``scope`` (register/evict/delete). The fused multi-scope cache is
+        cleared outright — its tuples may span any scopes."""
         self._pid_tables.pop(scope, None)
         self._pid_hashes.pop(scope, None)
-        self._pid_epoch += 1
         self._fused_pid_cache.clear()
 
     def _fused_pid_lookup(self, scopes: list) -> "_PidLookup | None":

@@ -184,8 +184,11 @@ def pid_lookup(
     vals = np.ascontiguousarray(table_vals, np.int64)
     q = np.ascontiguousarray(queries, np.int64)
     if len(keys) < 2:
-        # Empty table (size-1 sentinel-only): shift would be 64, a UB
-        # shift width in C — and nothing can match anyway.
+        # Defensive only — unreachable from the engine: _PidLookup always
+        # builds a table of size >= 2 (n = max(len(pids), 1), size doubles
+        # until >= 2n). Kept for direct callers of this binding: a size-1
+        # table would make shift == 64, a UB shift width in C — and a
+        # sentinel-only table can't match anything anyway.
         return np.zeros(len(q), bool), np.zeros(len(q), np.int64)
     found = np.empty(len(q), np.uint8)
     out = np.empty(len(q), np.int64)

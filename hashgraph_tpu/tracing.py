@@ -17,6 +17,22 @@ Usage::
 Disabled by default: a disabled tracer's ``span`` is a no-op context manager
 and ``count``/``event`` return immediately (one attribute check), so the hot
 path pays nothing until someone calls ``tracer.enable()``.
+
+Well-known counter families (all emitted through the process-wide default
+tracer unless a component was given its own):
+
+- ``engine.*`` — votes_in / votes_accepted / transitions / host_spills /
+  pid_collisions / timeout_sweeps / timeouts_fired / fresh_dispatches;
+- ``wal.*`` — the durability subsystem (:mod:`hashgraph_tpu.wal`):
+  ``wal.append_records`` and ``wal.append_bytes`` (log growth),
+  ``wal.fsync`` (durability syscalls — the throughput/durability dial),
+  ``wal.rotate`` (segment seals), ``wal.recover.records`` (replayed on
+  restart), ``wal.compact.segments`` (dropped behind snapshots),
+  ``wal.repair.truncated_bytes`` (torn tail removed at open), and the
+  recovery-loss counters ``wal.recover.torn_bytes`` /
+  ``wal.recover.dropped_segments`` / ``wal.recover.decode_errors``
+  (nonzero dropped_segments/decode_errors = mid-log corruption, not a
+  crash tail — acknowledged records were lost).
 """
 
 from __future__ import annotations

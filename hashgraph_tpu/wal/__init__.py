@@ -1,0 +1,55 @@
+"""Durability subsystem: segmented write-ahead log + crash recovery.
+
+The library core performs no I/O by contract; this package is the optional
+durability layer an embedder composes around an engine:
+
+- :mod:`.format` — CRC32-framed record layout over the canonical
+  ``wire.py`` Proposal/Vote bytes (no second serialization format);
+- :mod:`.segment` — ``wal-<base_lsn>.seg`` segmented files, sealed on
+  rotation, torn-tail repair confined to the active segment;
+- :mod:`.writer` — :class:`WalWriter` with per-record / batched-every-N /
+  off fsync policies, rotation, and snapshot-anchored compaction;
+- :mod:`.recovery` — :func:`scan` + :func:`replay` through the engine's
+  own batch ingest paths (recovered traffic is validated like live
+  traffic, torn tails truncate at the first bad frame);
+- :mod:`.durable` — :class:`DurableEngine`, the log-before-acknowledge
+  engine wrapper with :meth:`~DurableEngine.recover` and
+  :meth:`~DurableEngine.checkpoint`.
+
+Quick start::
+
+    from hashgraph_tpu.engine import TpuConsensusEngine
+    from hashgraph_tpu.wal import DurableEngine
+
+    durable = DurableEngine(engine, "/var/lib/app/wal", fsync_policy="batch")
+    durable.recover(storage)          # snapshot + WAL tail -> warm engine
+    durable.create_proposal(...)      # logged before acknowledged
+    durable.checkpoint(storage)       # snapshot, mark, drop covered segments
+
+Tracing: the subsystem emits ``wal.append_records`` / ``wal.append_bytes``
+/ ``wal.fsync`` / ``wal.rotate`` / ``wal.recover.records`` /
+``wal.compact.segments`` / ``wal.repair.truncated_bytes`` counters, plus
+the recovery-loss counters ``wal.recover.torn_bytes`` /
+``wal.recover.dropped_segments`` / ``wal.recover.decode_errors``, through
+:mod:`hashgraph_tpu.tracing` (no-ops until the tracer is enabled).
+"""
+
+from . import format, recovery, segment
+from .durable import DurableEngine
+from .recovery import ReplayStats, WalScan, replay, scan
+from .writer import FSYNC_ALWAYS, FSYNC_BATCH, FSYNC_OFF, WalWriter
+
+__all__ = [
+    "DurableEngine",
+    "WalWriter",
+    "ReplayStats",
+    "WalScan",
+    "replay",
+    "scan",
+    "FSYNC_ALWAYS",
+    "FSYNC_BATCH",
+    "FSYNC_OFF",
+    "format",
+    "recovery",
+    "segment",
+]

@@ -17,7 +17,39 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import numpy as np
+
 __all__ = ["Vote", "Proposal"]
+
+
+def normalize_wire_votes(wire_votes, count: int) -> "tuple[bytes, np.ndarray]":
+    """Normalize a columnar ``wire_votes`` argument — a list of encoded
+    Vote bytes, or an already-packed ``(data, offsets)`` pair — to one
+    packed blob plus validated int64 row offsets. Shared by the engine's
+    columnar ingest (which views the blob as uint8) and the WAL's columnar
+    records (which store it verbatim), so the two layers cannot drift on
+    what a well-formed batch is."""
+    if isinstance(wire_votes, tuple):
+        data, offsets = wire_votes
+        blob = (
+            bytes(data)
+            if isinstance(data, (bytes, bytearray, memoryview))
+            else np.asarray(data, np.uint8).tobytes()
+        )
+        offsets = np.asarray(offsets, np.int64)
+    else:
+        blob = b"".join(wire_votes)
+        offsets = np.zeros(len(wire_votes) + 1, np.int64)
+        np.cumsum([len(b) for b in wire_votes], out=offsets[1:])
+    if len(offsets) != count + 1:
+        raise ValueError("wire_votes must supply one entry per batch row")
+    if len(offsets) and int(offsets[-1]) > len(blob):
+        raise ValueError("wire_votes offsets exceed the packed data")
+    if len(offsets) and (int(offsets[0]) < 0 or (np.diff(offsets) < 0).any()):
+        raise ValueError(
+            "wire_votes offsets must be non-negative and non-decreasing"
+        )
+    return blob, offsets
 
 _U32_MASK = 0xFFFFFFFF
 _U64_MASK = 0xFFFFFFFFFFFFFFFF

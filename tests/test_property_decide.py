@@ -7,6 +7,10 @@ far beyond the transcribed reference tables.
 """
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 
 import jax.numpy as jnp

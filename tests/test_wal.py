@@ -1,0 +1,612 @@
+"""WAL primitives: framing, segments, writer policies, tail repair,
+compaction, and the DurableEngine wrapper's logging discipline."""
+
+import os
+
+import pytest
+
+from hashgraph_tpu import (
+    ConsensusConfig,
+    CreateProposalRequest,
+    InMemoryConsensusStorage,
+    NetworkType,
+    ScopeConfig,
+    build_vote,
+)
+from hashgraph_tpu.engine import TpuConsensusEngine
+from hashgraph_tpu.tracing import Tracer
+from hashgraph_tpu.wal import WalWriter, replay, scan
+from hashgraph_tpu.wal import format as F
+from hashgraph_tpu.wal.durable import DurableEngine
+from hashgraph_tpu.wal.segment import base_lsn_of, list_segments, segment_name
+
+from common import NOW, random_stub_signer
+
+
+def request(n=3, name="p", exp=1000, liveness=True):
+    return CreateProposalRequest(
+        name=name,
+        payload=b"x",
+        proposal_owner=b"o",
+        expected_voters_count=n,
+        expiration_timestamp=exp,
+        liveness_criteria_yes=liveness,
+    )
+
+
+class TestFormat:
+    def test_record_roundtrip(self):
+        frame = F.encode_record(7, F.KIND_SWEEP, F.encode_sweep(NOW))
+        records, end = F.scan_buffer(frame)
+        assert records == [(7, F.KIND_SWEEP, F.encode_sweep(NOW))]
+        assert end == len(frame)
+
+    def test_scan_stops_at_corrupt_crc(self):
+        good = F.encode_record(1, F.KIND_SWEEP, F.encode_sweep(1))
+        bad = bytearray(F.encode_record(2, F.KIND_SWEEP, F.encode_sweep(2)))
+        bad[-1] ^= 0xFF  # flip a payload byte -> CRC mismatch
+        records, end = F.scan_buffer(good + bytes(bad))
+        assert [lsn for lsn, _, _ in records] == [1]
+        assert end == len(good)
+
+    def test_scan_stops_at_short_frame(self):
+        good = F.encode_record(1, F.KIND_SWEEP, F.encode_sweep(1))
+        torn = F.encode_record(2, F.KIND_SWEEP, F.encode_sweep(2))[:-3]
+        records, end = F.scan_buffer(good + torn)
+        assert len(records) == 1 and end == len(good)
+
+    def test_scope_roundtrip(self):
+        for scope in ["alpha", b"\x00\xffraw", 0, 123456789, -5, True]:
+            blob = F.encode_scope(scope)
+            decoded = F.decode_scope(F.Reader(blob))
+            assert decoded == (int(scope) if isinstance(scope, bool) else scope)
+
+    def test_scope_rejects_non_canonical(self):
+        with pytest.raises(TypeError):
+            F.encode_scope(("tuple", "scope"))
+
+    def test_scope_config_roundtrip(self):
+        config = ScopeConfig(
+            network_type=NetworkType.P2P,
+            default_consensus_threshold=0.9,
+            default_timeout=30.0,
+            default_liveness_criteria_yes=False,
+            max_rounds_override=7,
+        )
+        out = F.decode_scope_config(F.Reader(F.encode_scope_config(config)))
+        assert out == config
+        config.max_rounds_override = None
+        out = F.decode_scope_config(F.Reader(F.encode_scope_config(config)))
+        assert out.max_rounds_override is None
+
+    def test_consensus_config_roundtrip(self):
+        config = ConsensusConfig(
+            consensus_threshold=0.75,
+            consensus_timeout=12.5,
+            max_rounds=9,
+            use_gossipsub_rounds=False,
+            liveness_criteria=False,
+        )
+        assert (
+            F.decode_consensus_config(F.Reader(F.encode_consensus_config(config)))
+            == config
+        )
+
+    def test_segment_names_sort(self):
+        assert base_lsn_of(segment_name(42)) == 42
+        assert base_lsn_of("not-a-segment.txt") is None
+        assert segment_name(9) < segment_name(10) < segment_name(100)
+
+
+class TestWriter:
+    def test_append_scan_roundtrip(self, tmp_path):
+        with WalWriter(tmp_path, fsync_policy="off") as wal:
+            lsns = [wal.append(F.KIND_SWEEP, F.encode_sweep(NOW + i)) for i in range(5)]
+        assert lsns == [1, 2, 3, 4, 5]
+        result = scan(str(tmp_path))
+        assert [lsn for lsn, _, _ in result.records] == lsns
+        assert not result.torn
+        assert result.last_lsn == 5
+
+    def test_reopen_continues_lsns(self, tmp_path):
+        with WalWriter(tmp_path, fsync_policy="off") as wal:
+            wal.append(F.KIND_SWEEP, F.encode_sweep(1))
+        with WalWriter(tmp_path, fsync_policy="off") as wal:
+            assert wal.last_lsn == 1
+            assert wal.append(F.KIND_SWEEP, F.encode_sweep(2)) == 2
+
+    def test_second_writer_rejected_while_first_live(self, tmp_path):
+        with WalWriter(tmp_path, fsync_policy="off") as wal:
+            wal.append(F.KIND_SWEEP, F.encode_sweep(1))
+            with pytest.raises(ValueError, match="locked"):
+                WalWriter(tmp_path, fsync_policy="off")
+        # flock released on close: a successor opens normally.
+        with WalWriter(tmp_path, fsync_policy="off") as wal:
+            assert wal.last_lsn == 1
+
+    def test_rotation_and_cross_segment_scan(self, tmp_path):
+        with WalWriter(tmp_path, fsync_policy="off", segment_bytes=64) as wal:
+            for i in range(20):
+                wal.append(F.KIND_SWEEP, F.encode_sweep(i))
+        segments = list_segments(str(tmp_path))
+        assert len(segments) > 1
+        # Segment base lsns tile the record range contiguously.
+        result = scan(str(tmp_path))
+        assert [lsn for lsn, _, _ in result.records] == list(range(1, 21))
+
+    def test_torn_tail_repaired_on_open(self, tmp_path):
+        with WalWriter(tmp_path, fsync_policy="off") as wal:
+            for i in range(3):
+                wal.append(F.KIND_SWEEP, F.encode_sweep(i))
+        (path,) = [p for _, p in list_segments(str(tmp_path))]
+        with open(path, "ab") as fh:
+            fh.write(b"\x99\x07garbage-torn-tail")
+        pre = scan(str(tmp_path))
+        assert pre.torn and len(pre.records) == 3
+        with WalWriter(tmp_path, fsync_policy="off") as wal:  # repairs
+            assert wal.last_lsn == 3
+            wal.append(F.KIND_SWEEP, F.encode_sweep(99))
+        post = scan(str(tmp_path))
+        assert not post.torn
+        assert [lsn for lsn, _, _ in post.records] == [1, 2, 3, 4]
+
+    def test_fsync_policies(self, tmp_path):
+        tracer = Tracer(enabled=True)
+        with WalWriter(
+            tmp_path / "always", fsync_policy="always", tracer=tracer
+        ) as wal:
+            for i in range(4):
+                wal.append(F.KIND_SWEEP, F.encode_sweep(i))
+        per_record = tracer.counters()["wal.fsync"]
+        assert per_record >= 4  # one per append (+ close)
+
+        tracer = Tracer(enabled=True)
+        with WalWriter(
+            tmp_path / "batch", fsync_policy="batch", fsync_interval=3, tracer=tracer
+        ) as wal:
+            for i in range(7):
+                wal.append(F.KIND_SWEEP, F.encode_sweep(i))
+        batched = tracer.counters()["wal.fsync"]
+        assert batched == 3  # lsn 3, lsn 6, close
+
+        tracer = Tracer(enabled=True)
+        with WalWriter(tmp_path / "off", fsync_policy="off", tracer=tracer) as wal:
+            for i in range(7):
+                wal.append(F.KIND_SWEEP, F.encode_sweep(i))
+        assert tracer.counters()["wal.fsync"] == 1  # close only
+
+        with pytest.raises(ValueError):
+            WalWriter(tmp_path / "bad", fsync_policy="sometimes")
+
+    def test_append_counters(self, tmp_path):
+        tracer = Tracer(enabled=True)
+        with WalWriter(tmp_path, fsync_policy="off", tracer=tracer) as wal:
+            wal.append(F.KIND_SWEEP, F.encode_sweep(0))
+        counters = tracer.counters()
+        assert counters["wal.append_records"] == 1
+        assert counters["wal.append_bytes"] > 0
+
+    def test_compaction_drops_only_covered_sealed_segments(self, tmp_path):
+        with WalWriter(tmp_path, fsync_policy="off", segment_bytes=64) as wal:
+            for i in range(20):
+                wal.append(F.KIND_SWEEP, F.encode_sweep(i))
+            segments = list_segments(str(tmp_path))
+            assert len(segments) >= 3
+            # Cover everything up to the penultimate segment's records.
+            watermark = segments[-1][0] - 1
+            removed = wal.compact(watermark)
+            assert removed == len(segments) - 1
+            survivors = list_segments(str(tmp_path))
+            assert [base for base, _ in survivors] == [segments[-1][0]]
+            # Surviving records replay exactly the uncovered tail.
+            result = scan(str(tmp_path))
+            assert [lsn for lsn, _, _ in result.records] == list(
+                range(segments[-1][0], 21)
+            )
+
+
+class TestDurableEngineLogging:
+    def make(self, tmp_path, **wal_kwargs):
+        engine = TpuConsensusEngine(
+            random_stub_signer(), capacity=16, voter_capacity=8
+        )
+        wal_kwargs.setdefault("fsync_policy", "off")
+        return DurableEngine(engine, tmp_path, **wal_kwargs)
+
+    def test_one_record_per_mutator(self, tmp_path):
+        durable = self.make(tmp_path)
+        durable.scope("s").with_network_type(NetworkType.P2P).initialize()
+        pid = durable.create_proposal("s", request(3), NOW).proposal_id
+        durable.cast_vote("s", pid, True, NOW)
+        kinds = [kind for _, kind, _ in scan(str(tmp_path)).records]
+        assert kinds == [F.KIND_SCOPE_CONFIG, F.KIND_PROPOSALS, F.KIND_VOTES]
+
+    def test_reads_do_not_log(self, tmp_path):
+        durable = self.make(tmp_path)
+        pid = durable.create_proposal("s", request(3), NOW).proposal_id
+        before = len(scan(str(tmp_path)).records)
+        durable.get_proposal("s", pid)
+        durable.get_scope_stats("s")
+        durable.get_consensus_result("s", pid)
+        assert len(scan(str(tmp_path)).records) == before
+
+    def test_rejected_call_still_replays_identically(self, tmp_path):
+        from hashgraph_tpu import UserAlreadyVoted
+
+        durable = self.make(tmp_path)
+        pid = durable.create_proposal("s", request(3), NOW).proposal_id
+        durable.cast_vote("s", pid, True, NOW)
+        with pytest.raises(UserAlreadyVoted):
+            durable.cast_vote("s", pid, True, NOW)
+        fresh = TpuConsensusEngine(
+            random_stub_signer(), capacity=16, voter_capacity=8
+        )
+        replay(str(tmp_path), fresh)
+        session = fresh.export_session("s", pid)
+        assert len(session.votes) == 1  # the duplicate stayed rejected
+
+    def test_columnar_requires_wire_votes(self, tmp_path):
+        import numpy as np
+
+        durable = self.make(tmp_path)
+        with pytest.raises(ValueError, match="wire_votes"):
+            durable.ingest_columnar(
+                "s",
+                np.zeros(1, np.int64),
+                np.zeros(1, np.int64),
+                np.zeros(1, bool),
+                NOW,
+            )
+
+    def test_columnar_rejected_rows_never_logged(self, tmp_path):
+        """The live columnar call trusts the caller's columns; replay
+        re-derives them from wire bytes with fresh gid interning. A row the
+        engine rejected live (here: a bogus pid column entry whose wire
+        bytes carry the REAL pid) must not reach the log, or replay would
+        accept what the live engine dropped."""
+        import numpy as np
+
+        from hashgraph_tpu.errors import StatusCode
+
+        durable = self.make(tmp_path)
+        proposal = durable.create_proposal("s", request(4), NOW)
+        votes = chained_votes(
+            proposal, [random_stub_signer() for _ in range(2)], NOW + 1
+        )
+        gids = np.array([durable.voter_gid(v.vote_owner) for v in votes])
+        pids = np.full(len(votes), proposal.proposal_id, np.int64)
+        pids[1] = 999_999  # unknown pid -> row rejected live
+        statuses = durable.ingest_columnar(
+            "s",
+            pids,
+            gids,
+            np.array([v.vote for v in votes]),
+            NOW + 10,
+            wire_votes=[v.encode() for v in votes],
+        )
+        assert statuses[0] == int(StatusCode.OK)
+        assert statuses[1] != int(StatusCode.OK)
+        fresh = TpuConsensusEngine(
+            random_stub_signer(), capacity=16, voter_capacity=8
+        )
+        stats = replay(str(tmp_path), fresh)
+        assert stats.votes_replayed == 1  # only the accepted row was logged
+        assert len(
+            fresh.export_session("s", proposal.proposal_id).votes
+        ) == len(durable.export_session("s", proposal.proposal_id).votes)
+
+    def test_delete_scope_replays(self, tmp_path):
+        durable = self.make(tmp_path)
+        durable.create_proposal("gone", request(3), NOW)
+        durable.create_proposal("kept", request(3), NOW)
+        durable.delete_scope("gone")
+        fresh = TpuConsensusEngine(
+            random_stub_signer(), capacity=16, voter_capacity=8
+        )
+        replay(str(tmp_path), fresh)
+        assert fresh.get_scope_stats("gone").total_sessions == 0
+        assert fresh.get_scope_stats("kept").total_sessions == 1
+
+    def test_checkpoint_compacts_everything_covered(self, tmp_path):
+        durable = self.make(tmp_path, segment_bytes=256)
+        for i in range(12):
+            durable.create_proposal("s", request(3, name=f"p{i}"), NOW + i)
+        assert len(list_segments(str(tmp_path))) > 1
+        storage = InMemoryConsensusStorage()
+        saved = durable.checkpoint(storage)
+        assert saved == 10  # per-scope LRU cap keeps the newest 10
+        survivors = list_segments(str(tmp_path))
+        # Everything pre-snapshot was sealed and dropped; the single
+        # surviving (active) segment holds only the snapshot mark.
+        assert len(survivors) == 1
+        kinds = [kind for _, kind, _ in scan(str(tmp_path)).records]
+        assert kinds == [F.KIND_SNAPSHOT]
+        # Snapshot + empty tail recovers the full state. The live writer
+        # must close first: the directory flock admits one writer at a time.
+        expected_sessions = durable.get_scope_stats("s").total_sessions
+        durable.close()
+        fresh = TpuConsensusEngine(
+            random_stub_signer(), capacity=16, voter_capacity=8
+        )
+        recovered = DurableEngine(fresh, tmp_path, fsync_policy="off")
+        stats = recovered.recover(storage)
+        assert stats.records_applied == 0
+        assert recovered.get_scope_stats("s").total_sessions == expected_sessions
+
+    def test_timeout_and_sweep_replay(self, tmp_path):
+        durable = self.make(tmp_path)
+        pid = durable.create_proposal(
+            "s", request(4, liveness=False, exp=50), NOW
+        ).proposal_id
+        assert durable.handle_consensus_timeout("s", pid, NOW + 60) is False
+        pid2 = durable.create_proposal(
+            "s", request(4, exp=50, liveness=True), NOW
+        ).proposal_id
+        swept = durable.sweep_timeouts(NOW + 120)
+        assert [(s, p) for s, p, _ in swept] == [("s", pid2)]
+        fresh = TpuConsensusEngine(
+            random_stub_signer(), capacity=16, voter_capacity=8
+        )
+        replay(str(tmp_path), fresh)
+        assert fresh.get_consensus_result("s", pid) is False
+        assert fresh.get_consensus_result("s", pid2) is True
+
+
+def chained_votes(proposal, signers, now):
+    """Chain-linked votes the way real peers build them: each vote links to
+    the proposal's current tail."""
+    votes = []
+    ferry = proposal.clone()
+    for i, signer in enumerate(signers):
+        vote = build_vote(ferry, True, signer, now + i)
+        ferry.votes.append(vote)
+        votes.append(vote)
+    return votes
+
+
+class TestRecordBudget:
+    """MAX_RECORD enforcement + DurableEngine batch splitting: an oversized
+    record must be rejected BEFORE acknowledgment (a frame over the cap
+    reads as a torn tail and would silently destroy everything after it),
+    and oversized batches must split across records instead of hitting it."""
+
+    def make(self, tmp_path, **kwargs):
+        engine = TpuConsensusEngine(
+            random_stub_signer(), capacity=16, voter_capacity=8
+        )
+        kwargs.setdefault("fsync_policy", "off")
+        return DurableEngine(engine, tmp_path, **kwargs)
+
+    def test_oversize_append_rejected_before_ack(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(F, "MAX_RECORD", 1024)  # avoid a 64 MiB payload
+        with WalWriter(tmp_path, fsync_policy="off") as wal:
+            wal.append(F.KIND_SWEEP, F.encode_sweep(1))
+            with pytest.raises(ValueError, match="MAX_RECORD"):
+                wal.append(F.KIND_VOTES, b"x" * 2048)
+            wal.append(F.KIND_SWEEP, F.encode_sweep(2))
+        result = scan(str(tmp_path))
+        # The rejected record left no trace: contiguous LSNs, no torn tail.
+        assert [lsn for lsn, _, _ in result.records] == [1, 2]
+        assert not result.torn
+
+    def test_vote_batch_splits_across_records(self, tmp_path):
+        durable = self.make(tmp_path / "live", record_budget=200)
+        proposal = durable.create_proposal("s", request(6), NOW)
+        votes = chained_votes(
+            proposal, [random_stub_signer() for _ in range(4)], NOW + 1
+        )
+        durable.ingest_votes([("s", v) for v in votes], NOW + 10)
+        records = scan(str(tmp_path / "live")).records
+        vote_records = [r for r in records if r[1] == F.KIND_VOTES]
+        assert len(vote_records) > 1  # the wave crossed the budget
+        assert [lsn for lsn, _, _ in records] == list(range(1, len(records) + 1))
+        fresh = TpuConsensusEngine(
+            random_stub_signer(), capacity=16, voter_capacity=8
+        )
+        stats = replay(str(tmp_path / "live"), fresh)
+        assert stats.errors == []
+        assert stats.votes_replayed == 4
+        assert len(fresh.export_session("s", proposal.proposal_id).votes) == len(
+            durable.export_session("s", proposal.proposal_id).votes
+        )
+
+    def test_unloggable_create_rejected_before_apply(self, tmp_path, monkeypatch):
+        """Locally-minted paths log AFTER applying (the wire bytes only
+        exist then), so a create whose record could exceed MAX_RECORD must
+        fail BEFORE the engine mutates — otherwise the live engine holds a
+        proposal recovery can never reproduce."""
+        monkeypatch.setattr(F, "MAX_RECORD", 2048)
+        durable = self.make(tmp_path, record_budget=2048)
+        big = CreateProposalRequest(
+            name="big",
+            payload=b"x" * 4096,
+            proposal_owner=b"o",
+            expected_voters_count=3,
+            expiration_timestamp=1000,
+            liveness_criteria_yes=True,
+        )
+        with pytest.raises(ValueError, match="too large to log"):
+            durable.create_proposal("s", big, NOW)
+        assert durable.get_scope_stats("s").total_sessions == 0  # no mutation
+        assert scan(str(tmp_path)).records == []  # no record either
+
+    def test_timeout_pid_not_masked(self):
+        scope, pid, now = F.decode_timeout(
+            F.encode_timeout("s", (1 << 32) + 5, NOW)
+        )
+        assert pid == (1 << 32) + 5  # replay re-raises SessionNotFound, not
+        # a masked timeout against pid 5
+
+    def test_mid_log_corruption_reported_in_replay_stats(self, tmp_path):
+        with WalWriter(tmp_path, fsync_policy="off", segment_bytes=64) as wal:
+            for i in range(12):
+                wal.append(F.KIND_SWEEP, F.encode_sweep(i))
+        segments = list_segments(str(tmp_path))
+        assert len(segments) >= 3
+        with open(segments[1][1], "r+b") as fh:  # corrupt a SEALED segment
+            fh.seek(2)
+            fh.write(b"\xff\xff")
+        fresh = TpuConsensusEngine(
+            random_stub_signer(), capacity=16, voter_capacity=8
+        )
+        stats = replay(str(tmp_path), fresh)
+        assert stats.torn
+        assert stats.torn_path == segments[1][1]
+        assert stats.segments_dropped == len(segments) - 2
+
+    def test_columnar_batch_splits_and_replays(self, tmp_path):
+        import numpy as np
+
+        durable = self.make(tmp_path / "live", record_budget=200)
+        proposal = durable.create_proposal("s", request(4), NOW)
+        votes = chained_votes(
+            proposal, [random_stub_signer() for _ in range(3)], NOW + 1
+        )
+        gids = np.array([durable.voter_gid(v.vote_owner) for v in votes])
+        durable.ingest_columnar(
+            "s",
+            np.full(len(votes), proposal.proposal_id, np.int64),
+            gids,
+            np.array([v.vote for v in votes]),
+            NOW + 10,
+            wire_votes=[v.encode() for v in votes],
+        )
+        records = scan(str(tmp_path / "live")).records
+        col_records = [r for r in records if r[1] == F.KIND_COLUMNAR]
+        assert len(col_records) > 1
+        fresh = TpuConsensusEngine(
+            random_stub_signer(), capacity=16, voter_capacity=8
+        )
+        stats = replay(str(tmp_path / "live"), fresh)
+        assert stats.errors == []
+        assert stats.votes_replayed == 3
+        assert fresh.get_consensus_result(
+            "s", proposal.proposal_id
+        ) == durable.get_consensus_result("s", proposal.proposal_id)
+
+
+class TestBridgeWal:
+    def test_bridge_peer_recovers_after_restart(self, tmp_path):
+        from hashgraph_tpu.bridge import protocol as P
+        from hashgraph_tpu.bridge.server import BridgeServer
+        import socket
+
+        key = os.urandom(32)
+        wal_dir = str(tmp_path)
+
+        def rpc(sock, opcode, payload):
+            sock.sendall(P.encode_frame(opcode, payload))
+            status, cursor = P.read_frame(sock)
+            assert status == P.STATUS_OK, status
+            return cursor
+
+        def add_peer_and_propose(create: bool):
+            with BridgeServer(capacity=8, voter_capacity=8, wal_dir=wal_dir) as server:
+                host, port = server.address
+                with socket.create_connection((host, port)) as sock:
+                    c = rpc(sock, P.OP_ADD_PEER, P.u8(32) + key)
+                    peer_id = c.u32()
+                    if create:
+                        c = rpc(
+                            sock,
+                            P.OP_CREATE_PROPOSAL,
+                            P.u32(peer_id)
+                            + P.string("scope")
+                            + P.u64(NOW)
+                            + P.string("p")
+                            + P.blob(b"payload")
+                            + P.u32(3)
+                            + P.u64(1000)
+                            + P.u8(1),
+                        )
+                        pid = c.u32()
+                        rpc(
+                            sock,
+                            P.OP_CAST_VOTE,
+                            P.u32(peer_id)
+                            + P.string("scope")
+                            + P.u32(pid)
+                            + P.u8(1)
+                            + P.u64(NOW),
+                        )
+                        return pid
+                    c = rpc(
+                        sock,
+                        P.OP_GET_STATS,
+                        P.u32(peer_id) + P.string("scope"),
+                    )
+                    return (c.u32(), c.u32(), c.u32(), c.u32())
+
+        add_peer_and_propose(create=True)
+        # "Crash": the server went away; a new server + same key re-adds the
+        # peer, whose WAL replays the proposal and vote.
+        total, active, failed, reached = add_peer_and_propose(create=False)
+        assert total == 1 and active == 1
+
+    def test_same_run_readd_reuses_live_wal(self, tmp_path):
+        """Re-ADD_PEER with the same key in ONE server run must reuse the
+        live durable engine — a second WalWriter on the same directory
+        would interleave duplicate LSNs under the first."""
+        from hashgraph_tpu.bridge import protocol as P
+        from hashgraph_tpu.bridge.server import BridgeServer
+        import socket
+
+        key = os.urandom(32)
+
+        def rpc(sock, opcode, payload):
+            sock.sendall(P.encode_frame(opcode, payload))
+            status, cursor = P.read_frame(sock)
+            assert status == P.STATUS_OK, status
+            return cursor
+
+        with BridgeServer(
+            capacity=8, voter_capacity=8, wal_dir=str(tmp_path)
+        ) as server:
+            host, port = server.address
+            with socket.create_connection((host, port)) as sock:
+                peer_a = rpc(sock, P.OP_ADD_PEER, P.u8(32) + key).u32()
+                rpc(
+                    sock,
+                    P.OP_CREATE_PROPOSAL,
+                    P.u32(peer_a)
+                    + P.string("scope")
+                    + P.u64(NOW)
+                    + P.string("p")
+                    + P.blob(b"payload")
+                    + P.u32(3)
+                    + P.u64(1000)
+                    + P.u8(1),
+                )
+                peer_b = rpc(sock, P.OP_ADD_PEER, P.u8(32) + key).u32()
+                assert peer_b != peer_a
+                # Same engine behind both peer ids: B sees A's proposal.
+                c = rpc(sock, P.OP_GET_STATS, P.u32(peer_b) + P.string("scope"))
+                assert c.u32() == 1  # total_sessions
+        records = scan(
+            str(tmp_path / ("peer-" + key_identity_hex(key)))
+        ).records
+        lsns = [lsn for lsn, _, _ in records]
+        assert lsns == sorted(set(lsns))  # strictly increasing, no duplicates
+
+    def test_keyless_peer_gets_no_wal(self, tmp_path):
+        """A keyless ADD_PEER mints an identity that can never be
+        re-presented, so wrapping it would only accumulate dead WAL dirs."""
+        from hashgraph_tpu.bridge import protocol as P
+        from hashgraph_tpu.bridge.server import BridgeServer
+        import socket
+
+        with BridgeServer(
+            capacity=8, voter_capacity=8, wal_dir=str(tmp_path)
+        ) as server:
+            host, port = server.address
+            with socket.create_connection((host, port)) as sock:
+                sock.sendall(P.encode_frame(P.OP_ADD_PEER, P.u8(0)))
+                status, _ = P.read_frame(sock)
+                assert status == P.STATUS_OK
+        assert os.listdir(str(tmp_path)) == []
+
+
+def key_identity_hex(key: bytes) -> str:
+    from hashgraph_tpu import EthereumConsensusSigner
+
+    return EthereumConsensusSigner(key).identity().hex()

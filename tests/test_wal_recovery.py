@@ -1,0 +1,312 @@
+"""Crash-recovery property test (seeded randomized trials, no external
+fuzzing dependency so it always runs in CI).
+
+Property: for a randomized workload logged to a WAL and truncated at an
+ARBITRARY byte offset (torn write), ``recovery.replay`` into a fresh engine
+yields an engine observably identical to a live engine that saw exactly the
+surviving prefix of calls — consensus results, scope stats, vote
+chains/tallies, rounds, AND continued behavior (re-ingesting any recorded
+vote produces identical statuses, duplicate rejection included). A second
+suite runs the same property through a snapshot + compaction cycle.
+
+The mirror ("live engine that saw the surviving prefix") is reconstructed
+from the recorded op list: the wrapper appends exactly one WAL record per
+acknowledged mutator call, so record k of the log IS call k of the prefix.
+"""
+
+import os
+import random
+
+import numpy as np
+
+from hashgraph_tpu import (
+    ConsensusError,
+    ConsensusFailed,
+    CreateProposalRequest,
+    InMemoryConsensusStorage,
+    NetworkType,
+    ScopeConfig,
+    SessionNotFound,
+    StubConsensusSigner,
+    build_vote,
+)
+from hashgraph_tpu.engine import TpuConsensusEngine
+from hashgraph_tpu.wal import DurableEngine, replay, scan
+from hashgraph_tpu.wal.segment import list_segments
+
+from common import NOW
+
+SCOPES = ["s0", "s1", "s2"]
+
+
+def _request(rng):
+    return CreateProposalRequest(
+        name=f"p{rng.randrange(1 << 30)}",
+        payload=os.urandom(rng.randrange(0, 12)),
+        proposal_owner=b"owner",
+        expected_voters_count=rng.randint(2, 5),
+        expiration_timestamp=rng.randint(5, 60),
+        liveness_criteria_yes=rng.random() < 0.5,
+    )
+
+
+def _fresh_engine(identity: bytes) -> TpuConsensusEngine:
+    return TpuConsensusEngine(
+        StubConsensusSigner(identity), capacity=32, voter_capacity=8
+    )
+
+
+def _run_workload(durable, rng, n_ops, t0=NOW):
+    """Drive a random mix of mutators; returns (ops, pids) where ops[k]
+    mirrors WAL record lsn t0_lsn+k one-to-one (a call that raised before
+    logging appends no op, matching the wrapper's no-record behavior)."""
+    ops = []
+    pids = []
+    remote_signers = {}
+    t = t0
+    for _ in range(n_ops):
+        r = rng.random()
+        if r < 0.30 or not pids:
+            scope = rng.choice(SCOPES)
+            proposal = durable.create_proposal(scope, _request(rng), t)
+            ops.append(("proposal", scope, proposal.clone(), t))
+            pids.append((scope, proposal.proposal_id))
+            remote_signers[(scope, proposal.proposal_id)] = []
+        elif r < 0.70:
+            scope, pid = rng.choice(pids)
+            try:
+                proposal = durable.get_proposal(scope, pid)
+            except SessionNotFound:
+                continue  # evicted by the per-scope cap; reads log nothing
+            used = remote_signers[(scope, pid)]
+            if used and rng.random() < 0.3:
+                signer = rng.choice(used)  # deliberate duplicate voter
+            else:
+                signer = StubConsensusSigner(os.urandom(20))
+                used.append(signer)
+            vote = build_vote(proposal, rng.random() < 0.5, signer, t)
+            ops.append(("votes", scope, vote.clone(), t, False))
+            try:
+                durable.process_incoming_vote(scope, vote, t)
+            except ConsensusError:
+                pass  # rejection was logged before apply; replay re-rejects
+        elif r < 0.85:
+            scope, pid = rng.choice(pids)
+            try:
+                vote = durable.cast_vote(scope, pid, rng.random() < 0.5, t)
+            except ConsensusError:
+                continue  # raised before logging -> no record, no op
+            ops.append(("votes", scope, vote.clone(), t, True))
+        elif r < 0.92:
+            scope, pid = rng.choice(pids)
+            ops.append(("timeout", scope, pid, t))
+            try:
+                durable.handle_consensus_timeout(scope, pid, t)
+            except ConsensusError:
+                pass
+        else:
+            ops.append(("sweep", t))
+            durable.sweep_timeouts(t)
+        t += rng.randint(0, 3)
+    return ops, pids
+
+
+def _apply_op(engine, op):
+    kind = op[0]
+    if kind == "proposal":
+        _, scope, proposal, now = op
+        engine.ingest_proposals([(scope, proposal.clone())], now)
+    elif kind == "votes":
+        _, scope, vote, now, pre_validated = op
+        engine.ingest_votes([(scope, vote.clone())], now, pre_validated=pre_validated)
+    elif kind == "timeout":
+        _, scope, pid, now = op
+        try:
+            engine.handle_consensus_timeout(scope, pid, now)
+        except ConsensusError:
+            pass
+    elif kind == "sweep":
+        engine.sweep_timeouts(op[1])
+    elif kind == "config":
+        _, scope, config = op
+        engine.set_scope_config(scope, config)
+    elif kind == "mark":
+        pass
+    else:  # pragma: no cover
+        raise AssertionError(f"unknown mirror op {kind}")
+
+
+def _observable(engine, pids):
+    """Everything the acceptance criteria call observable: per-scope stats,
+    consensus results, vote chains/tallies, rounds."""
+    out = {}
+    for scope in SCOPES:
+        stats = engine.get_scope_stats(scope)
+        out[("stats", scope)] = (
+            stats.total_sessions,
+            stats.active_sessions,
+            stats.failed_sessions,
+            stats.consensus_reached,
+        )
+    for scope, pid in pids:
+        try:
+            result = engine.get_consensus_result(scope, pid)
+        except ConsensusFailed:
+            result = "failed"
+        except SessionNotFound:
+            out[("session", scope, pid)] = "missing"
+            continue
+        session = engine.export_session(scope, pid)
+        out[("session", scope, pid)] = (
+            result,
+            session.proposal.round,
+            len(session.proposal.votes),
+            tuple(sorted((o.hex(), v.vote) for o, v in session.votes.items())),
+            tuple(sorted((o.hex(), val) for o, val in session.tallies.items())),
+        )
+    return out
+
+
+def _copy_truncated(src: str, dst: str, cut: int) -> None:
+    """Byte-prefix copy of a WAL directory: keep the first ``cut`` bytes of
+    the concatenated segment stream (segment order = LSN order)."""
+    os.makedirs(dst, exist_ok=True)
+    consumed = 0
+    for _base, path in list_segments(src):
+        size = os.path.getsize(path)
+        if cut <= consumed:
+            break
+        keep = min(size, cut - consumed)
+        with open(path, "rb") as fh:
+            data = fh.read(keep)
+        with open(os.path.join(dst, os.path.basename(path)), "wb") as fh:
+            fh.write(data)
+        consumed += size
+
+
+class TestTornTailRecoveryProperty:
+    def test_randomized_torn_tail_equivalence(self, tmp_path):
+        for seed in range(6):
+            self._trial(seed, tmp_path / f"trial{seed}")
+
+    def _trial(self, seed, root):
+        rng = random.Random(0xC0FFEE + seed)
+        identity = os.urandom(20)
+        live = DurableEngine(
+            _fresh_engine(identity),
+            root / "wal",
+            fsync_policy="off",
+            segment_bytes=1024,  # small segments: cuts cross boundaries
+        )
+        # A scope config record up front so replay covers that kind too.
+        config = ScopeConfig(network_type=NetworkType.P2P)
+        live.set_scope_config("s1", config)
+        ops = [("config", "s1", config)]
+        more_ops, pids = _run_workload(live, rng, n_ops=30)
+        ops.extend(more_ops)
+        live.close()
+
+        src = str(root / "wal")
+        total = sum(os.path.getsize(p) for _, p in list_segments(src))
+        assert len(scan(src).records) == len(ops)  # 1 record per call
+
+        cut = rng.randrange(0, total + 1)
+        dst = str(root / "cut")
+        _copy_truncated(src, dst, cut)
+
+        surviving = scan(dst)
+        k = len(surviving.records)
+        assert k <= len(ops)
+        # LSNs are the contiguous prefix 1..k — truncation is whole-record.
+        assert [lsn for lsn, _, _ in surviving.records] == list(range(1, k + 1))
+
+        recovered = _fresh_engine(identity)
+        stats = replay(dst, recovered)
+        assert stats.errors == []
+        assert stats.records_applied == k
+
+        mirror = _fresh_engine(identity)
+        for op in ops[:k]:
+            _apply_op(mirror, op)
+
+        assert _observable(recovered, pids) == _observable(mirror, pids), (
+            f"seed={seed} cut={cut}/{total} k={k}"
+        )
+
+        # Continued behavior: every recorded vote (seen or unseen by the
+        # prefix) gets the IDENTICAL status from both engines — duplicate
+        # rejection, unknown sessions, late votes, all of it.
+        vote_items = [
+            (op[1], op[2].clone()) for op in ops if op[0] == "votes"
+        ]
+        if vote_items:
+            t_end = NOW + 1000
+            got_a = recovered.ingest_votes(
+                [(s, v.clone()) for s, v in vote_items], t_end
+            )
+            got_b = mirror.ingest_votes(
+                [(s, v.clone()) for s, v in vote_items], t_end
+            )
+            assert np.array_equal(got_a, got_b), f"seed={seed}"
+
+
+class TestSnapshotCompactionRecoveryProperty:
+    def test_torn_tail_after_checkpoint(self, tmp_path):
+        for seed in range(3):
+            self._trial(seed, tmp_path / f"trial{seed}")
+
+    def _trial(self, seed, root):
+        rng = random.Random(0xBEEF + seed)
+        identity = os.urandom(20)
+        live = DurableEngine(
+            _fresh_engine(identity),
+            root / "wal",
+            fsync_policy="off",
+            segment_bytes=512,
+        )
+        ops, pids = _run_workload(live, rng, n_ops=20)
+
+        # Snapshot + compaction: every covered segment is deleted.
+        src = str(root / "wal")
+        assert len(list_segments(src)) > 1
+        storage = InMemoryConsensusStorage()
+        live.checkpoint(storage)
+        ops.append(("mark", None))
+        survivors = list_segments(src)
+        assert len(survivors) == 1  # only the fresh active segment remains
+        assert scan(src).watermark == len(ops) - 1  # everything pre-mark
+
+        more_ops, more_pids = _run_workload(live, rng, n_ops=15, t0=NOW + 100)
+        ops.extend(more_ops)
+        pids = pids + [p for p in more_pids if p not in pids]
+        live.close()
+
+        total = sum(os.path.getsize(p) for _, p in list_segments(src))
+        cut = rng.randrange(0, total + 1)
+        dst = str(root / "cut")
+        _copy_truncated(src, dst, cut)
+
+        # Recover through the real entry point: snapshot, then WAL tail.
+        recovered = DurableEngine(
+            _fresh_engine(identity), dst, fsync_policy="off"
+        )
+        recovered.recover(storage)
+
+        surviving = scan(dst)
+        watermark = surviving.watermark
+        mirror = _fresh_engine(identity)
+        mirror.load_from_storage(storage)
+        for lsn, _, _ in surviving.records:
+            if lsn > watermark:
+                _apply_op(mirror, ops[lsn - 1])
+
+        assert _observable(recovered.engine, pids) == _observable(mirror, pids), (
+            f"seed={seed} cut={cut}/{total}"
+        )
+
+        # The recovered node can checkpoint again and compaction still
+        # holds the invariant: one active segment, nothing else.
+        storage2 = InMemoryConsensusStorage()
+        recovered.checkpoint(storage2)
+        assert len(list_segments(dst)) == 1
+        recovered.close()

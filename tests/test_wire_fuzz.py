@@ -1,5 +1,9 @@
 """Property-based wire-codec fuzz: round-trips and decoder robustness."""
 
+import pytest
+
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 
 from hashgraph_tpu.wire import Proposal, Vote
