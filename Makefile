@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench
+.PHONY: verify tier1 dev-install test bench metrics-smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -25,3 +25,10 @@ test:
 
 bench:
 	python bench.py
+
+# End-to-end observability check: start a bridge server (WAL + HTTP
+# sidecar), drive a proposal to decision, scrape /metrics + /healthz and
+# the GET_METRICS opcode, and assert the well-known metric families are
+# present. See examples/metrics_smoke.py.
+metrics-smoke:
+	JAX_PLATFORMS=cpu python examples/metrics_smoke.py

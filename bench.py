@@ -23,6 +23,13 @@ measures the durability subsystem: append throughput per fsync policy,
 DurableEngine ingest overhead vs a bare engine, and recovery replay rate
 (host-only — not part of the BASELINE sweep).
 
+``--metrics-out PATH`` additionally snapshots the always-on observability
+registry (:mod:`hashgraph_tpu.obs` — counter totals, gauges, and histogram
+quantiles such as ``wal_fsync_seconds`` p50/p90/p99) into the emitted JSON
+and writes the full result object to PATH. ``--metrics-port N`` serves the
+HTTP ``/metrics`` + ``/healthz`` sidecar for the run's duration so the
+histograms can be scraped live while the bench executes.
+
 Traces are pre-validated replays (signature/hash verification is the
 pluggable host stage — measured separately by ``python bench.py crypto``
 and the validated end-to-end mode; the reference's own tests hand-deliver
@@ -1322,7 +1329,40 @@ def run_default() -> dict:
 if __name__ == "__main__":
     import sys
 
-    which = sys.argv[1] if len(sys.argv) > 1 else "default"
+    # --metrics-out PATH: after the run, snapshot the always-on metrics
+    # registry (counters, gauges, histogram count/sum/p50/p90/p99 — e.g.
+    # wal_fsync_seconds quantiles, hashgraph_decision_latency_seconds)
+    # into the BENCH json alongside the throughput numbers, and also write
+    # the full result to PATH (one JSON object).
+    args = sys.argv[1:]
+
+    def _pop_flag(name: str) -> str | None:
+        """Extract `NAME VALUE` from args; None when absent."""
+        if name not in args:
+            return None
+        flag = args.index(name)
+        if flag + 1 >= len(args):
+            raise SystemExit(f"{name} requires a value")
+        value = args[flag + 1]
+        del args[flag : flag + 2]
+        return value
+
+    metrics_out = _pop_flag("--metrics-out")
+
+    # --metrics-port N: serve /metrics + /healthz for the duration of the
+    # run (0 = ephemeral; the bound address is printed to stderr so stdout
+    # stays one JSON line), so `curl` can watch histograms fill live.
+    sidecar = None
+    sidecar_port = _pop_flag("--metrics-port")
+    if sidecar_port is not None:
+        from hashgraph_tpu.obs import MetricsSidecar, registry
+
+        sidecar = MetricsSidecar(registry, port=int(sidecar_port))
+        host, port = sidecar.start()
+        print(f"metrics sidecar listening on http://{host}:{port}/metrics",
+              file=sys.stderr)
+
+    which = args[0] if args else "default"
     runners = {
         "engine": run_engine_bench,
         "pool": run_bench,
@@ -1341,7 +1381,13 @@ if __name__ == "__main__":
         "wal": run_wal,
         "default": run_default,
     }
+    def _registry_snapshot() -> dict:
+        from hashgraph_tpu.obs import registry
+
+        return registry.snapshot()
+
     if which == "all":
+        results = {}
         for name in (
             "engine",
             "pool",
@@ -1356,6 +1402,19 @@ if __name__ == "__main__":
             "engine_config5",
             "engine_config5_retained",
         ):
-            print(json.dumps(runners[name]()))
+            results[name] = runners[name]()
+            print(json.dumps(results[name]))
+        if metrics_out is not None:
+            with open(metrics_out, "w") as fh:
+                json.dump(
+                    {"results": results, "metrics": _registry_snapshot()}, fh
+                )
     else:
-        print(json.dumps(runners[which]()))
+        result = runners[which]()
+        if metrics_out is not None:
+            result["metrics"] = _registry_snapshot()
+            with open(metrics_out, "w") as fh:
+                json.dump(result, fh)
+        print(json.dumps(result))
+    if sidecar is not None:
+        sidecar.stop()

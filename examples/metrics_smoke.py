@@ -1,0 +1,88 @@
+"""Observability smoke check (the `make metrics-smoke` target).
+
+Starts a BridgeServer with the HTTP metrics sidecar and a WAL directory,
+drives one proposal to decision over the wire, then asserts:
+
+- ``/metrics`` serves Prometheus text containing the well-known families
+  (decision-latency histogram buckets, WAL fsync histogram, ingest batch
+  size, bridge request counters);
+- ``/healthz`` reports ok with the expected peer count;
+- the ``GET_METRICS`` bridge opcode returns the same families over the
+  wire protocol.
+
+Exit code 0 and a final ``metrics-smoke OK`` line mean the scrape path
+works end to end.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, ".")  # run from the repo root, as the Makefile does
+
+from hashgraph_tpu.bridge.client import BridgeClient  # noqa: E402
+from hashgraph_tpu.bridge.server import BridgeServer  # noqa: E402
+
+NOW = 1_700_000_000
+
+REQUIRED_FAMILIES = [
+    "hashgraph_decision_latency_seconds_bucket",
+    "hashgraph_decision_latency_seconds_count",
+    "hashgraph_ingest_batch_size_bucket",
+    "wal_fsync_seconds_bucket",
+    "wal_segment_count",
+    "hashgraph_live_proposals",
+    "bridge_requests_total",
+]
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as wal_dir:
+        server = BridgeServer(
+            capacity=16, voter_capacity=8, wal_dir=wal_dir,
+            wal_fsync="always", metrics_port=0,
+        )
+        with server:
+            host, port = server.address
+            mhost, mport = server.metrics_address
+            with BridgeClient(host, port) as alice, BridgeClient(host, port) as bob:
+                a_peer, _ = alice.add_peer(os.urandom(32))
+                b_peer, _ = bob.add_peer(os.urandom(32))
+                pid, proposal = alice.create_proposal(
+                    a_peer, "smoke", NOW, "p", b"payload", 2, 100
+                )
+                bob.process_proposal(b_peer, "smoke", proposal, NOW)
+                vote_a = alice.cast_vote(a_peer, "smoke", pid, True, NOW)
+                vote_b = bob.cast_vote(b_peer, "smoke", pid, True, NOW)
+                alice.process_vote(a_peer, "smoke", vote_b, NOW)
+                bob.process_vote(b_peer, "smoke", vote_a, NOW)
+                assert alice.get_result(a_peer, "smoke", pid) is True
+
+                # HTTP sidecar scrape.
+                with urllib.request.urlopen(
+                    f"http://{mhost}:{mport}/metrics", timeout=5
+                ) as response:
+                    text = response.read().decode("utf-8")
+                missing = [f for f in REQUIRED_FAMILIES if f not in text]
+                assert not missing, f"missing families in /metrics: {missing}"
+                assert 'le="+Inf"' in text, "histogram missing +Inf bucket"
+
+                with urllib.request.urlopen(
+                    f"http://{mhost}:{mport}/healthz", timeout=5
+                ) as response:
+                    health = json.loads(response.read())
+                assert health["ok"] and health["peers"] == 2, health
+
+                # Same families over the bridge wire (GET_METRICS opcode).
+                wire_text = alice.get_metrics()
+                missing = [f for f in REQUIRED_FAMILIES if f not in wire_text]
+                assert not missing, f"missing families via GET_METRICS: {missing}"
+
+    print("metrics-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,6 +70,8 @@ from .types import (
     CreateProposalRequest,
     SessionTransition,
 )
+from .obs import FlightRecorder, MetricsRegistry, MetricsSidecar
+from .obs import flight_recorder, registry as metrics_registry
 from .wal import DurableEngine, WalWriter
 from .wire import Proposal, Vote
 
@@ -80,6 +82,11 @@ __all__ = [
     "Vote",
     "DurableEngine",
     "WalWriter",
+    "MetricsRegistry",
+    "MetricsSidecar",
+    "FlightRecorder",
+    "metrics_registry",
+    "flight_recorder",
     "ConsensusService",
     "ConsensusStats",
     "ConsensusConfig",

@@ -187,3 +187,10 @@ class BridgeClient:
         """(total, active, failed, reached)."""
         cursor = self._call(P.OP_GET_STATS, P.u32(peer) + P.string(scope))
         return cursor.u32(), cursor.u32(), cursor.u32(), cursor.u32()
+
+    def get_metrics(self) -> str:
+        """Prometheus text-format scrape of the server process's metrics
+        registry (server-wide — no peer id). The same text the HTTP
+        sidecar's ``/metrics`` serves, for embedders that only hold the
+        bridge wire."""
+        return self._call(P.OP_GET_METRICS).blob().decode("utf-8")

@@ -47,6 +47,11 @@ OP_POLL_EVENTS = 8
 OP_GET_PROPOSAL = 9
 OP_GET_STATS = 10
 OP_PROCESS_VOTES = 11  # batch: u32 count + count vote blobs -> u8 statuses
+# Server-wide observability scrape (no peer_id prefix, like PING): returns
+# the process metrics registry rendered in Prometheus text format as one
+# byte blob — remote embedders scrape over the wire they already hold
+# instead of needing the HTTP sidecar reachable.
+OP_GET_METRICS = 12
 
 # Bridge-level statuses (protocol StatusCode values occupy 0..29).
 STATUS_OK = 0

@@ -23,6 +23,13 @@ from struct import error as struct_error
 from ..engine import TpuConsensusEngine
 from ..errors import ConsensusError
 from ..events import BroadcastEventBus, EventReceiver
+from ..obs import (
+    BRIDGE_ERRORS_TOTAL,
+    BRIDGE_REQUESTS_TOTAL,
+    MetricsSidecar,
+    flight_recorder,
+)
+from ..obs import registry as default_registry
 from ..signing import ConsensusSignatureScheme
 from ..signing.ethereum import EthereumConsensusSigner
 from ..types import (
@@ -49,6 +56,13 @@ class BridgeServer:
     ``engine_factory(signer)`` swaps the backing engine, e.g. one over a
     sharded device-mesh pool; the default builds a small single-chip engine
     per peer.
+
+    ``metrics_port`` (None = off, 0 = ephemeral) attaches an HTTP sidecar
+    serving ``/metrics`` (Prometheus text format over the process-wide
+    registry) and ``/healthz`` (JSON: running + peer count) for the
+    server's lifetime; read the bound port from :attr:`metrics_address`.
+    The ``GET_METRICS`` opcode serves the identical text over the bridge
+    wire itself, sidecar or not.
     """
 
     def __init__(
@@ -61,6 +75,8 @@ class BridgeServer:
         engine_factory=None,
         wal_dir: str | None = None,
         wal_fsync: str = "batch",
+        metrics_port: int | None = None,
+        metrics_host: str = "127.0.0.1",
     ):
         self._host = host
         self._port = port
@@ -93,6 +109,14 @@ class BridgeServer:
         self._connections: set[socket.socket] = set()
         self._handlers: set[threading.Thread] = set()
         self._running = False
+        # Observability: /metrics + /healthz HTTP sidecar (metrics_port
+        # 0 = ephemeral, None = no sidecar; the GET_METRICS opcode serves
+        # the same text over the bridge wire regardless).
+        self._metrics_port = metrics_port
+        self._metrics_host = metrics_host
+        self._sidecar: MetricsSidecar | None = None
+        self._m_requests = default_registry.counter(BRIDGE_REQUESTS_TOTAL)
+        self._m_errors = default_registry.counter(BRIDGE_ERRORS_TOTAL)
 
     # ── lifecycle ──────────────────────────────────────────────────────
 
@@ -102,6 +126,19 @@ class BridgeServer:
             raise RuntimeError("server not started")
         return self._listener.getsockname()[:2]
 
+    @property
+    def metrics_address(self) -> tuple[str, int]:
+        """(host, port) of the HTTP metrics sidecar (requires
+        ``metrics_port`` and a started server)."""
+        if self._sidecar is None:
+            raise RuntimeError("metrics sidecar not running")
+        return self._sidecar.address
+
+    def _health(self) -> dict:
+        with self._lock:
+            peers = len(self._peers)
+        return {"ok": self._running, "peers": peers}
+
     def start(self) -> tuple[str, int]:
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -109,6 +146,28 @@ class BridgeServer:
         listener.listen(16)
         self._listener = listener
         self._running = True
+        if self._metrics_port is not None:
+            try:
+                self._sidecar = MetricsSidecar(
+                    default_registry,
+                    host=self._metrics_host,
+                    port=self._metrics_port,
+                    health_fn=self._health,
+                )
+                self._sidecar.start()
+            except Exception:
+                # A sidecar bind failure (port in use) must not leave a
+                # half-started server holding the bridge listener: in the
+                # `with BridgeServer(...)` pattern a raising __enter__
+                # never reaches __exit__/stop().
+                self._sidecar = None
+                self._running = False
+                self._listener = None
+                try:
+                    listener.close()
+                except OSError:
+                    pass
+                raise
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
         return self.address
@@ -163,6 +222,9 @@ class BridgeServer:
                 del self._peers[peer_id]
         for engine in durable:
             engine.close()
+        if self._sidecar is not None:
+            self._sidecar.stop()
+            self._sidecar = None
 
     def __enter__(self) -> "BridgeServer":
         self.start()
@@ -213,14 +275,27 @@ class BridgeServer:
                 return
             if not self._running:
                 return
+            self._m_requests.inc()
+            flight_recorder.record("bridge.op", opcode=opcode)
             try:
                 status, payload = self._dispatch(opcode, cursor)
             except ConsensusError as exc:
                 status, payload = int(exc.code), P.string(str(exc))
             except (ValueError, KeyError, struct_error) as exc:
                 status, payload = P.STATUS_BAD_REQUEST, P.string(str(exc))
+                flight_recorder.record(
+                    "bridge.bad_request", opcode=opcode, error=str(exc)
+                )
             except Exception as exc:  # pragma: no cover - defensive
                 status, payload = P.STATUS_INTERNAL, P.string(repr(exc))
+                # Dispatch blew up unexpectedly (a peer engine died, a bug):
+                # preserve the ring for the postmortem before answering.
+                flight_recorder.record(
+                    "bridge.dispatch_error", opcode=opcode, error=repr(exc)
+                )
+                flight_recorder.dump("bridge-dispatch-error")
+            if status >= P.STATUS_UNKNOWN_PEER:
+                self._m_errors.inc()
             try:
                 conn.sendall(P.encode_frame(status, payload))
             except OSError:
@@ -233,6 +308,12 @@ class BridgeServer:
             return P.STATUS_OK, P.u32(P.PROTOCOL_VERSION)
         if opcode == P.OP_ADD_PEER:
             return self._op_add_peer(c)
+        if opcode == P.OP_GET_METRICS:
+            # Server-wide (no peer_id): the registry is process-global, so
+            # one scrape covers every peer engine plus WAL and bridge.
+            return P.STATUS_OK, P.blob(
+                default_registry.render_prometheus().encode("utf-8")
+            )
         handler = _HANDLERS.get(opcode)
         if handler is None:
             return P.STATUS_UNKNOWN_OPCODE, b""

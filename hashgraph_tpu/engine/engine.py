@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Generic, Hashable, TypeVar
 
@@ -38,6 +40,27 @@ from ..errors import (
     error_for_code,
 )
 from ..events import BroadcastEventBus, ConsensusEventBus
+from ..obs import (
+    CHAIN_KERNEL_SECONDS,
+    DECISION_LATENCY,
+    DECISIONS_TOTAL,
+    DEFAULT_SIZE_BUCKETS,
+    DEVICE_INGEST_SECONDS,
+    INGEST_BATCH_SIZE,
+    LIVE_PROPOSALS,
+    PROPOSALS_CREATED_TOTAL,
+    TIMEOUTS_FIRED_TOTAL,
+    VERIFY_BATCH_SECONDS,
+    VOTE_TABLE_OCCUPANCY,
+    VOTES_ACCEPTED_TOTAL,
+    VOTES_TOTAL,
+    TimelineStore,
+    flight_recorder,
+    observed_span,
+)
+from ..obs import registry as default_registry
+from ..obs.registry import Counter
+from ..obs.timeline import OUTCOME_FAILED, OUTCOME_NO, OUTCOME_YES
 from ..ops.decide import (
     STATE_ACTIVE,
     STATE_FAILED,
@@ -95,6 +118,14 @@ _STATE_TO_SCALAR = {
     STATE_FAILED: ConsensusState.failed(),
     STATE_REACHED_YES: ConsensusState.reached(True),
     STATE_REACHED_NO: ConsensusState.reached(False),
+}
+
+# Timeline outcome labels per dense lifecycle state (ACTIVE maps to None:
+# a transition list never carries it, but the .get guard is cheap).
+_OUTCOME_OF_STATE = {
+    STATE_REACHED_YES: OUTCOME_YES,
+    STATE_REACHED_NO: OUTCOME_NO,
+    STATE_FAILED: OUTCOME_FAILED,
 }
 
 
@@ -241,6 +272,49 @@ class TpuConsensusEngine(Generic[Scope]):
         else:
             self._process_zero = True
         self.tracer = default_tracer
+        # Always-on metrics (process-wide registry). Instruments are
+        # resolved once here so the per-batch hot paths pay attribute
+        # loads, not registry dict probes.
+        self.metrics = default_registry
+        self._m_votes_total = self.metrics.counter(VOTES_TOTAL)
+        self._m_votes_accepted = self.metrics.counter(VOTES_ACCEPTED_TOTAL)
+        self._m_decisions = self.metrics.counter(DECISIONS_TOTAL)
+        self._m_proposals = self.metrics.counter(PROPOSALS_CREATED_TOTAL)
+        self._m_timeouts = self.metrics.counter(TIMEOUTS_FIRED_TOTAL)
+        self._m_batch_size = self.metrics.histogram(
+            INGEST_BATCH_SIZE, DEFAULT_SIZE_BUCKETS
+        )
+        self._m_verify = self.metrics.histogram(VERIFY_BATCH_SECONDS)
+        self._m_chain = self.metrics.histogram(CHAIN_KERNEL_SECONDS)
+        self._m_device = self.metrics.histogram(DEVICE_INGEST_SECONDS)
+        # Per-proposal lifecycle timelines (created → first_vote → decided /
+        # timed_out), feeding the decision-latency histogram.
+        self._timelines = TimelineStore(
+            self.metrics.histogram(DECISION_LATENCY)
+        )
+        # Engine-state gauges sampled at scrape time, weakly bound: a
+        # collected engine's contribution vanishes instead of freezing.
+        ref = weakref.ref(self)
+
+        def _live_proposals() -> int:
+            engine = ref()
+            return len(engine._records) if engine is not None else 0
+
+        def _pool_occupancy() -> int:
+            engine = ref()
+            if engine is None:
+                return 0
+            # Claimed device slots (host-spilled sessions use negative
+            # synthetic ids and hold no pool row). list() snapshots the
+            # keys in one atomic C call — the scrape thread runs without
+            # the engine lock, and iterating the live dict would race
+            # with a concurrent insert/evict resize.
+            return sum(1 for s in list(engine._records) if s >= 0)
+
+        self.metrics.register_gauge(LIVE_PROPOSALS, _live_proposals, owner=self)
+        self.metrics.register_gauge(
+            VOTE_TABLE_OCCUPANCY, _pool_occupancy, owner=self
+        )
         # One engine-wide reentrant lock: the reference service is fully
         # thread-safe (whole-map RwLocks, src/storage.rs:192-193); the pool's
         # host mirrors and free lists need the same discipline. Coarse
@@ -269,6 +343,26 @@ class TpuConsensusEngine(Generic[Scope]):
 
     def signer(self) -> ConsensusSignatureScheme:
         return self._signer
+
+    def set_replay_mode(self, on: bool) -> None:
+        """Metrics gate for WAL recovery (DurableEngine.recover): replayed
+        traffic drives the live ingest paths, but the decisions it
+        re-applies were made before the crash — with replay mode on,
+        timelines stamp them ``pre_decided`` (outcome without latency) and
+        the decisions/timeouts counters hold still, so a restart doesn't
+        collapse the decision-latency quantiles or re-count pre-crash
+        decisions. Vote/proposal counters keep counting: they measure work
+        this process performed, and replay IS work."""
+        self._timelines.replay_mode = on
+        if on:
+            # Throwaway instruments: the ingest paths inc attributes
+            # unconditionally, so swapping the targets is cheaper (and
+            # less invasive) than flag checks on every site.
+            self._m_decisions = Counter("replay.decisions.discard")
+            self._m_timeouts = Counter("replay.timeouts.discard")
+        else:
+            self._m_decisions = self.metrics.counter(DECISIONS_TOTAL)
+            self._m_timeouts = self.metrics.counter(TIMEOUTS_FIRED_TOTAL)
 
     def event_bus(self) -> ConsensusEventBus[Scope]:
         return self._event_bus
@@ -632,6 +726,8 @@ class TpuConsensusEngine(Generic[Scope]):
         # dict probe: fit_idx is then simply 0..len(entries).
         records = self._records
         index = self._index
+        timelines = self._timelines
+        wall = time.monotonic()
         touched: set = set()
         cur_scope: object = object()  # sentinel unequal to any real scope
         cur_list: list = []
@@ -650,6 +746,7 @@ class TpuConsensusEngine(Generic[Scope]):
                 record = fresh(scope, slot, proposal, cfg, now)
             records[slot] = record
             index[(scope, proposal.proposal_id)] = slot
+            timelines.created(slot, scope, proposal.proposal_id, now, wall)
             if scope is not cur_scope:
                 cur_scope = scope
                 cur_list = self._scopes.setdefault(scope, [])
@@ -657,6 +754,9 @@ class TpuConsensusEngine(Generic[Scope]):
             cur_list.append(slot)
         for scope in touched:
             self._drop_pid_cache(scope)
+        if entries:
+            self._m_proposals.inc(len(entries))
+            flight_recorder.record("engine.create", proposals=len(entries))
         return [p.clone() for _, p, _ in entries]
 
     def process_incoming_proposal(
@@ -734,7 +834,12 @@ class TpuConsensusEngine(Generic[Scope]):
             spans.append((start, len(proposal.votes)))
         verdicts: list = []
         if flat_ids:
-            with self.tracer.span("engine.verify_batch", votes=len(flat_ids)):
+            with observed_span(
+                self.tracer,
+                "engine.verify_batch",
+                self._m_verify,
+                votes=len(flat_ids),
+            ):
                 verdicts = self._scheme.verify_batch(
                     flat_ids, flat_payloads, flat_sigs
                 )
@@ -748,7 +853,12 @@ class TpuConsensusEngine(Generic[Scope]):
             batchpack = {
                 key: np.stack([p[key] for p in packs]) for key in packs[0]
             }
-            with self.tracer.span("engine.chain_kernel", chains=len(chain_idx)):
+            with observed_span(
+                self.tracer,
+                "engine.chain_kernel",
+                self._m_chain,
+                chains=len(chain_idx),
+            ):
                 chain_statuses = np.asarray(
                     chain_kernel_batch(
                         batchpack["vote_hash"],
@@ -879,6 +989,10 @@ class TpuConsensusEngine(Generic[Scope]):
         self._index[(scope, record.proposal.proposal_id)] = slot
         self._scopes.setdefault(scope, []).append(slot)
         self._drop_pid_cache(scope)
+        self._timelines.created(
+            slot, scope, record.proposal.proposal_id, now, time.monotonic()
+        )
+        self._m_proposals.inc()
         return record
 
     def _register_session(
@@ -894,6 +1008,18 @@ class TpuConsensusEngine(Generic[Scope]):
         )
         if record.slot not in self._records:
             return  # evicted immediately by the per-scope cap (created_at tie)
+        state = state_code_of(session.state)
+        if state != STATE_ACTIVE:
+            # Loaded already-decided (snapshot restore / vote-carrying
+            # gossip): stamp the timeline's outcome but do NOT observe
+            # decision latency — the decision wasn't made by this engine.
+            self._timelines.decided(
+                record.slot,
+                _OUTCOME_OF_STATE[state],
+                created_at,
+                time.monotonic(),
+                pre_decided=True,
+            )
         if record.session is not None:
             return  # host-backed: the scalar session IS the state
         record.votes = {k: v.clone() for k, v in session.votes.items()}
@@ -955,6 +1081,11 @@ class TpuConsensusEngine(Generic[Scope]):
         """
         batch = len(items)
         self.tracer.count("engine.votes_in", batch)
+        wall = time.monotonic()
+        if batch:
+            self._m_votes_total.inc(batch)
+            self._m_batch_size.observe(batch)
+            flight_recorder.record("engine.ingest_votes", votes=batch)
         statuses = np.zeros(batch, np.int32)
         dev_rows: list[int] = []  # indices into items that reach the device
         slots = np.empty(batch, np.int64)
@@ -966,6 +1097,7 @@ class TpuConsensusEngine(Generic[Scope]):
         host_events: list[tuple[int, Scope, ConsensusEvent]] = []
         host_accepted = 0
         host_transitions = 0
+        host_owned_transitions = 0
 
         # Batched signature verification: one scheme call for the whole batch
         # (native runtime: one GIL-releasing threaded C call). Verdicts are
@@ -980,7 +1112,12 @@ class TpuConsensusEngine(Generic[Scope]):
                 and (slot < 0 or self._owns_slot(slot))  # skip misrouted rows
             ]
             if idxs:
-                with self.tracer.span("engine.verify_batch", votes=len(idxs)):
+                with observed_span(
+                    self.tracer,
+                    "engine.verify_batch",
+                    self._m_verify,
+                    votes=len(idxs),
+                ):
                     verdicts = self._scheme.verify_batch(
                         [items[i][1].vote_owner for i in idxs],
                         [items[i][1].signing_payload() for i in idxs],
@@ -1021,10 +1158,23 @@ class TpuConsensusEngine(Generic[Scope]):
                 was_active = record.session.state.is_active
                 code, event = self._host_add_vote(record, vote, now)
                 statuses[i] = code
-                host_accepted += code == int(StatusCode.OK)
-                host_transitions += (
-                    was_active and not record.session.state.is_active
-                )
+                if code == int(StatusCode.OK):
+                    host_accepted += 1
+                    self._timelines.voted(slot, now, wall)
+                if was_active and not record.session.state.is_active:
+                    host_transitions += 1
+                    # Host-spilled sessions are replicated on every
+                    # process: decision metrics are ownership-gated like
+                    # events so a fleet-wide sum counts each decision once.
+                    owned = self._owns_slot(slot)
+                    host_owned_transitions += owned
+                    self._timelines.decided(
+                        slot,
+                        _OUTCOME_OF_STATE[state_code_of(record.session.state)],
+                        now,
+                        wall,
+                        observe=owned,
+                    )
                 if event is not None and self._owns_slot(slot):
                     host_events.append((i, scope, event))
                 continue
@@ -1047,21 +1197,36 @@ class TpuConsensusEngine(Generic[Scope]):
                 )
             self.tracer.count("engine.votes_accepted", host_accepted)
             self.tracer.count("engine.transitions", host_transitions)
+            self._m_votes_accepted.inc(host_accepted)
+            self._m_decisions.inc(host_owned_transitions)
             for _, ev_scope, event in host_events:
                 self._emit(ev_scope, event)
             return statuses
 
         k = len(dev_rows)
-        with self.tracer.span("engine.device_ingest", votes=k):
+        with observed_span(
+            self.tracer, "engine.device_ingest", self._m_device, votes=k
+        ):
             dev_statuses, transitions = self._pool.ingest(
                 slots[:k], lanes[:k], values[:k], now
             )
         statuses[np.asarray(dev_rows)] = dev_statuses
-        self.tracer.count(
-            "engine.votes_accepted",
-            int(np.sum(dev_statuses == int(StatusCode.OK))) + host_accepted,
-        )
+        # Re-stamp the wall clock AFTER the device dispatch completed: a
+        # decision's latency must include the ingest that produced it (the
+        # columnar path stamps at the same point), not the batch-entry time.
+        wall = time.monotonic()
+        accepted = int(np.sum(dev_statuses == int(StatusCode.OK))) + host_accepted
+        self.tracer.count("engine.votes_accepted", accepted)
         self.tracer.count("engine.transitions", len(transitions) + host_transitions)
+        self._m_votes_accepted.inc(accepted)
+        # Device transitions are local by construction (misrouted votes
+        # were rejected before the dispatch); host-spilled ones were
+        # ownership-filtered above.
+        self._m_decisions.inc(len(transitions) + host_owned_transitions)
+        for slot, new_state in transitions:
+            outcome = _OUTCOME_OF_STATE.get(new_state)
+            if outcome is not None:
+                self._timelines.decided(slot, outcome, now, wall)
 
         # Host bookkeeping for accepted votes, in arrival order; remember the
         # last accepted vote per slot — that is the vote that flipped a slot
@@ -1077,6 +1242,8 @@ class TpuConsensusEngine(Generic[Scope]):
                 record.scalar_seqs.append(record.next_arrival_seq())
                 record.bump_round(1)
                 last_ok[int(slots[j])] = j
+        for slot in last_ok:
+            self._timelines.voted(slot, now, wall)
 
         # Event emission in per-vote arrival order, mirroring the scalar
         # path exactly: the deciding vote emits ConsensusReached, and every
@@ -1196,6 +1363,10 @@ class TpuConsensusEngine(Generic[Scope]):
             else None
         )
         self.tracer.count("engine.votes_in", batch)
+        if batch:
+            self._m_votes_total.inc(batch)
+            self._m_batch_size.observe(batch)
+            flight_recorder.record("engine.ingest_columnar", votes=batch)
         statuses = np.full(batch, int(StatusCode.SESSION_NOT_FOUND), np.int32)
         return wire_norm, statuses, batch == 0 and not self._multihost
 
@@ -1418,23 +1589,41 @@ class TpuConsensusEngine(Generic[Scope]):
         # Host-spilled sessions (negative slots): rare scalar fallback,
         # applied tally-only — fabricating unsigned Vote objects here would
         # poison the session's exportable chain (advisor r2 medium).
+        wall = time.monotonic()
         host_rows = np.nonzero(found & (slots < 0))[0]
         for i in host_rows:
-            record = self._records[int(slots[i])]
+            slot = int(slots[i])
+            record = self._records[slot]
             owner = self._pool.owner_of_gid(int(voter_gids[i]))
             was_active = record.session.state.is_active
             code, event = self._host_add_tally(
                 record, owner, bool(values[i]), now
             )
             statuses[i] = code
+            if code == int(StatusCode.OK):
+                self._timelines.voted(slot, now, wall)
+                self._m_votes_accepted.inc()
             self.tracer.count(
                 "engine.votes_accepted", int(code == int(StatusCode.OK))
             )
+            if was_active and not record.session.state.is_active:
+                # Ownership-gated like events: host-spilled sessions are
+                # replicated fleet-wide, decision metrics must not be.
+                owned = self._owns_slot(slot)
+                self._timelines.decided(
+                    slot,
+                    _OUTCOME_OF_STATE[state_code_of(record.session.state)],
+                    now,
+                    wall,
+                    observe=owned,
+                )
+                if owned:
+                    self._m_decisions.inc()
             self.tracer.count(
                 "engine.transitions",
                 int(was_active and not record.session.state.is_active),
             )
-            if event is not None and self._owns_slot(int(slots[i])):
+            if event is not None and self._owns_slot(slot):
                 self._emit(record.scope, event)
 
         dev_mask = found & (slots >= 0)
@@ -1666,9 +1855,15 @@ class TpuConsensusEngine(Generic[Scope]):
                 )
             )
             orig_of.append(sel[idx_k])
-        with self.tracer.span("engine.device_ingest", votes=int(len(order))):
+        with observed_span(
+            self.tracer,
+            "engine.device_ingest",
+            self._m_device,
+            votes=int(len(order)),
+        ):
             results = self._pool.complete_all(pendings)
 
+        wall = time.monotonic()
         accepted = 0
         reached_transitions: list[tuple[int, int]] = []
         n_transitions = 0
@@ -1679,8 +1874,13 @@ class TpuConsensusEngine(Generic[Scope]):
             for slot, new_state in transitions:
                 if new_state in (STATE_REACHED_YES, STATE_REACHED_NO):
                     reached_transitions.append((slot, new_state))
+                outcome = _OUTCOME_OF_STATE.get(new_state)
+                if outcome is not None:
+                    self._timelines.decided(slot, outcome, now, wall)
         self.tracer.count("engine.votes_accepted", accepted)
         self.tracer.count("engine.transitions", n_transitions)
+        self._m_votes_accepted.inc(accepted)
+        self._m_decisions.inc(n_transitions)
 
         # Round + late-vote bookkeeping per touched slot, via bincount over
         # the sorted-domain group index (no re-sort; totals are
@@ -1699,7 +1899,9 @@ class TpuConsensusEngine(Generic[Scope]):
             if ok_m.any():
                 cnt = np.bincount(grp_sorted[ok_m], minlength=len(uniq))
                 for g in np.nonzero(cnt)[0].tolist():
-                    self._records[int(uniq[g])].bump_round(int(cnt[g]))
+                    slot = int(uniq[g])
+                    self._records[slot].bump_round(int(cnt[g]))
+                    self._timelines.voted(slot, now, wall)
 
         # Events: one ConsensusReached per deciding transition plus one per
         # late (ALREADY_REACHED) vote — same per-session counts as the
@@ -1883,6 +2085,7 @@ class TpuConsensusEngine(Generic[Scope]):
             raise SessionNotFound()
         record = self._records[slot]
         owned = self._owns_slot(slot)
+        was_active = self._state_code(record) == STATE_ACTIVE
         if record.session is not None:
             new_state = self._host_timeout(record, now)
         else:
@@ -1895,6 +2098,22 @@ class TpuConsensusEngine(Generic[Scope]):
                 # state mirror, so the result is readable (and the owner
                 # emitted the event).
                 new_state = self._pool.state_of(slot)
+        if was_active and owned:
+            # Only count timeouts that actually fired, on the owning
+            # process only: the call is idempotent for already-decided
+            # sessions (polls must not inflate the counter), and in a
+            # multi-host fleet every process runs this collective — a
+            # metrics sum across processes must report one firing.
+            self._m_timeouts.inc()
+        outcome = _OUTCOME_OF_STATE.get(new_state)
+        if outcome is not None:
+            # Idempotent for sessions that already decided by votes (the
+            # store ignores a second outcome); the latency observation is
+            # ownership-gated like events, the timeline stamp is not.
+            self._timelines.decided(
+                slot, outcome, now, time.monotonic(), by_timeout=True,
+                observe=owned,
+            )
         if new_state in (STATE_REACHED_YES, STATE_REACHED_NO):
             result = new_state == STATE_REACHED_YES
             if owned:
@@ -1941,6 +2160,11 @@ class TpuConsensusEngine(Generic[Scope]):
                     expired.append(slot)
         self.tracer.count("engine.timeout_sweeps")
         self.tracer.count("engine.timeouts_fired", len(expired) + len(host_expired))
+        if expired or host_expired:
+            flight_recorder.record(
+                "engine.sweep", fired=len(expired) + len(host_expired)
+            )
+        wall = time.monotonic()
         out: list[tuple[Scope, int, bool | None]] = []
         # pool.timeout is collective on a multi-host pool and returns only
         # this process's slots; host-spilled sessions advance identically on
@@ -1953,7 +2177,16 @@ class TpuConsensusEngine(Generic[Scope]):
             )
             for slot in host_expired
         ]
+        # Fired count and latency observations are ownership-gated like
+        # events: a multi-host fleet's metrics sum must report each swept
+        # session once, not once per process.
+        self._m_timeouts.inc(sum(1 for _, _, owned in swept if owned))
         for slot, new_state, owned in swept:
+            outcome = _OUTCOME_OF_STATE.get(new_state)
+            if outcome is not None:
+                self._timelines.decided(
+                    slot, outcome, now, wall, by_timeout=True, observe=owned
+                )
             if not owned:
                 continue
             record = self._records[slot]
@@ -2073,6 +2306,22 @@ class TpuConsensusEngine(Generic[Scope]):
                 stats.consensus_reached += 1
         return stats
 
+    def proposal_timeline(self, scope: Scope, proposal_id: int) -> dict | None:
+        """Lifecycle timeline readout for one proposal: created /
+        first_vote / quorum / decided logical timestamps, outcome
+        (yes/no/failed + by_timeout), and the derived wall-clock latencies
+        (``decision_latency_s`` is what feeds the
+        ``hashgraph_decision_latency_seconds`` histogram). Falls back to
+        the bounded finished-timeline ring for recently deleted/evicted
+        sessions; None when the proposal was never seen (or aged out)."""
+        slot = self._index.get((scope, proposal_id))
+        if slot is not None:
+            tl = self._timelines.get(slot)
+            if tl is not None and tl.proposal_id == proposal_id:
+                return tl.as_dict()
+        tl = self._timelines.find(scope, proposal_id)
+        return tl.as_dict() if tl is not None else None
+
     def export_session(self, scope: Scope, proposal_id: int) -> ConsensusSession:
         """Materialise a scalar ConsensusSession from the pooled state —
         the bridge back to ConsensusStorage backends (checkpoint/interop).
@@ -2176,6 +2425,7 @@ class TpuConsensusEngine(Generic[Scope]):
             for slot in slots:
                 record = self._records.pop(slot)
                 del self._index[(scope, record.proposal.proposal_id)]
+                self._timelines.forget(slot)
             # Host spills (slot < 0) have no pool slot to release.
             all_slots.extend(s for s in slots if s >= 0)
             self._scope_configs.pop(scope, None)
@@ -2299,6 +2549,7 @@ class TpuConsensusEngine(Generic[Scope]):
             for slot in evicted:
                 record = self._records.pop(slot)
                 del self._index[(scope, record.proposal.proposal_id)]
+                self._timelines.forget(slot)
             self._pool.release([s for s in evicted if s >= 0])
             self._drop_pid_cache(scope)
         return newcomer not in keep
@@ -2424,7 +2675,26 @@ def _synchronized(fn):
     @functools.wraps(fn)
     def wrapper(self, *args, **kwargs):
         with self._lock:
-            return fn(self, *args, **kwargs)
+            try:
+                return fn(self, *args, **kwargs)
+            except ConsensusError:
+                # The engine's caller-facing contract: typed rejections,
+                # not faults — no flight dump for them.
+                raise
+            except Exception as exc:
+                # Anything else — including a bare KeyError/ValueError from
+                # internal bookkeeping, which is almost always an invariant
+                # break, not an API rejection — is a fault: preserve the
+                # evidence. The ring already holds the recent
+                # batch/creation/sweep events; dumping is rate-limited
+                # inside the recorder, so a crash loop (or a caller
+                # hammering a malformed-argument path) costs one file per
+                # second, not one per call.
+                flight_recorder.record(
+                    "engine.fault", api=fn.__name__, error=repr(exc)
+                )
+                flight_recorder.dump(f"engine-fault:{fn.__name__}")
+                raise
 
     return wrapper
 
@@ -2452,6 +2722,8 @@ for _name in (
     "get_active_proposals",
     "get_reached_proposals",
     "get_scope_stats",
+    "proposal_timeline",
+    "set_replay_mode",
     "export_session",
     "save_to_storage",
     "load_from_storage",

@@ -1,0 +1,159 @@
+"""hashgraph_tpu.obs — the production observability layer.
+
+Four pieces, layered on (not replacing) the opt-in tracer in
+:mod:`hashgraph_tpu.tracing`:
+
+- :class:`MetricsRegistry` (``registry`` is the process-wide default):
+  always-on counters / gauges / log-bucketed histograms cheap enough for
+  per-batch hot paths;
+- per-proposal lifecycle timelines (:mod:`.timeline`), recorded by
+  ``TpuConsensusEngine`` and feeding the decision-latency histogram;
+- exposition: Prometheus text rendering (:mod:`.prometheus`), an HTTP
+  ``/metrics`` + ``/healthz`` sidecar (:mod:`.http`), and the bridge's
+  ``GET_METRICS`` opcode;
+- the always-on :class:`FlightRecorder` (``flight_recorder`` is the
+  process-wide ring), auto-dumped as JSONL on engine faults and bridge
+  dispatch exceptions.
+
+Well-known families (all on the default registry):
+
+==============================================  =========  ==================
+family                                          type       source
+==============================================  =========  ==================
+hashgraph_decision_latency_seconds              histogram  engine (create→decide wall time)
+hashgraph_ingest_batch_size                     histogram  engine (votes per ingest call)
+hashgraph_verify_batch_seconds                  histogram  engine (signature batch verify)
+hashgraph_chain_kernel_seconds                  histogram  engine (device chain validation)
+hashgraph_device_ingest_seconds                 histogram  engine (device vote dispatch)
+wal_fsync_seconds                               histogram  WAL writer (per fsync syscall)
+wal_recover_seconds                             histogram  DurableEngine.recover
+hashgraph_live_proposals                        gauge      engines (tracked sessions)
+hashgraph_vote_table_occupancy                  gauge      engines (claimed pool slots)
+wal_segment_count / wal_segment_bytes           gauge      WAL writers (live log footprint)
+hashgraph_votes_total / _accepted_total         counter    engine ingest paths
+hashgraph_proposals_created_total               counter    engine registration
+hashgraph_decisions_total                       counter    engine transitions
+hashgraph_timeouts_fired_total                  counter    engine timeout paths
+bridge_requests_total / bridge_errors_total     counter    bridge dispatch loop
+flight_dumps_total                              counter    flight recorder dump sites
+wal_checkpoints_total                           counter    DurableEngine checkpoints
+==============================================  =========  ==================
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from .flight import FlightRecorder, flight_recorder
+from .http import MetricsSidecar
+from .registry import (
+    DEFAULT_SIZE_BUCKETS,
+    DEFAULT_TIME_BUCKETS,
+    Counter,
+    Gauge,
+    GaugeHandle,
+    Histogram,
+    MetricsRegistry,
+    log_buckets,
+)
+from .timeline import ProposalTimeline, TimelineStore
+
+# ── Well-known family names ────────────────────────────────────────────
+
+DECISION_LATENCY = "hashgraph_decision_latency_seconds"
+INGEST_BATCH_SIZE = "hashgraph_ingest_batch_size"
+VERIFY_BATCH_SECONDS = "hashgraph_verify_batch_seconds"
+CHAIN_KERNEL_SECONDS = "hashgraph_chain_kernel_seconds"
+DEVICE_INGEST_SECONDS = "hashgraph_device_ingest_seconds"
+WAL_FSYNC_SECONDS = "wal_fsync_seconds"
+WAL_RECOVER_SECONDS = "wal_recover_seconds"
+
+LIVE_PROPOSALS = "hashgraph_live_proposals"
+VOTE_TABLE_OCCUPANCY = "hashgraph_vote_table_occupancy"
+WAL_SEGMENT_COUNT = "wal_segment_count"
+WAL_SEGMENT_BYTES = "wal_segment_bytes"
+
+VOTES_TOTAL = "hashgraph_votes_total"
+VOTES_ACCEPTED_TOTAL = "hashgraph_votes_accepted_total"
+PROPOSALS_CREATED_TOTAL = "hashgraph_proposals_created_total"
+DECISIONS_TOTAL = "hashgraph_decisions_total"
+TIMEOUTS_FIRED_TOTAL = "hashgraph_timeouts_fired_total"
+BRIDGE_REQUESTS_TOTAL = "bridge_requests_total"
+BRIDGE_ERRORS_TOTAL = "bridge_errors_total"
+FLIGHT_DUMPS_TOTAL = "flight_dumps_total"
+WAL_CHECKPOINTS_TOTAL = "wal_checkpoints_total"
+
+# Process-wide default registry (mirrors tracing.tracer's role).
+registry = MetricsRegistry()
+
+
+def _install_well_known(reg: MetricsRegistry) -> None:
+    """Create the well-known families eagerly so a scrape sees them from
+    process start (a dashboard query against an idle node must not 404)."""
+    for name in (
+        DECISION_LATENCY,
+        VERIFY_BATCH_SECONDS,
+        CHAIN_KERNEL_SECONDS,
+        DEVICE_INGEST_SECONDS,
+        WAL_FSYNC_SECONDS,
+        WAL_RECOVER_SECONDS,
+    ):
+        reg.histogram(name, DEFAULT_TIME_BUCKETS)
+    reg.histogram(INGEST_BATCH_SIZE, DEFAULT_SIZE_BUCKETS)
+    for name in (
+        LIVE_PROPOSALS,
+        VOTE_TABLE_OCCUPANCY,
+        WAL_SEGMENT_COUNT,
+        WAL_SEGMENT_BYTES,
+    ):
+        reg.gauge(name)
+    for name in (
+        VOTES_TOTAL,
+        VOTES_ACCEPTED_TOTAL,
+        PROPOSALS_CREATED_TOTAL,
+        DECISIONS_TOTAL,
+        TIMEOUTS_FIRED_TOTAL,
+        BRIDGE_REQUESTS_TOTAL,
+        BRIDGE_ERRORS_TOTAL,
+        FLIGHT_DUMPS_TOTAL,
+        WAL_CHECKPOINTS_TOTAL,
+    ):
+        reg.counter(name)
+
+
+_install_well_known(registry)
+flight_recorder.dump_counter = registry.counter(FLIGHT_DUMPS_TOTAL)
+
+
+@contextlib.contextmanager
+def observed_span(tracer, name: str, histogram: Histogram, **attrs):
+    """Time a block into BOTH observability layers: always observe the
+    duration into ``histogram`` (registry, always on), and record a tracer
+    span when tracing is enabled. One perf_counter pair when tracing is
+    off — cheap enough for per-batch sites, which is where this is used."""
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        duration = time.perf_counter() - start
+        histogram.observe(duration)
+        if tracer.enabled:
+            tracer.record_span(name, start, duration, attrs)
+
+
+__all__ = [
+    "Counter",
+    "FlightRecorder",
+    "Gauge",
+    "GaugeHandle",
+    "Histogram",
+    "MetricsRegistry",
+    "MetricsSidecar",
+    "ProposalTimeline",
+    "TimelineStore",
+    "flight_recorder",
+    "log_buckets",
+    "observed_span",
+    "registry",
+]

@@ -1,0 +1,95 @@
+"""Stdlib-threaded HTTP sidecar: ``/metrics`` (Prometheus text format) and
+``/healthz`` (JSON liveness) without any dependency beyond ``http.server``.
+
+The sidecar is deliberately tiny: scrapes are infrequent (seconds apart)
+and the render is a single registry walk, so a ThreadingHTTPServer on a
+daemon thread is plenty. It binds loopback by default for the same reason
+the bridge does — it is an in-machine surface; exposure is the embedder's
+call (pass ``host="0.0.0.0"`` explicitly to take that decision).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .prometheus import CONTENT_TYPE
+
+
+class MetricsSidecar:
+    """Serve one registry over HTTP. ``health_fn`` (optional) returns the
+    JSON body for ``/healthz``; a falsy ``"ok"`` key turns the status into
+    503 so load balancers can act on it."""
+
+    def __init__(
+        self,
+        registry,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        health_fn=None,
+    ):
+        self._registry = registry
+        self._host = host
+        self._port = port
+        self._health_fn = health_fn
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        if self._server is None:
+            raise RuntimeError("sidecar not started")
+        return self._server.server_address[:2]
+
+    def start(self) -> tuple[str, int]:
+        registry = self._registry
+        health_fn = self._health_fn
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib naming)
+                if self.path.split("?", 1)[0] == "/metrics":
+                    body = registry.render_prometheus().encode("utf-8")
+                    self._reply(200, CONTENT_TYPE, body)
+                elif self.path.split("?", 1)[0] == "/healthz":
+                    payload = {"ok": True}
+                    if health_fn is not None:
+                        try:
+                            payload = health_fn()
+                        except Exception as exc:
+                            payload = {"ok": False, "error": repr(exc)}
+                    status = 200 if payload.get("ok", True) else 503
+                    self._reply(
+                        status,
+                        "application/json",
+                        json.dumps(payload).encode("utf-8"),
+                    )
+                else:
+                    self._reply(404, "text/plain", b"not found\n")
+
+            def _reply(self, status: int, ctype: str, body: bytes) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes must not spam stderr
+                pass
+
+        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.address
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,66 @@
+"""Prometheus text-format exposition (version 0.0.4) for a MetricsRegistry.
+
+One render pass walks the registry snapshot-free: counters and gauges are
+single samples; histograms expose the standard ``_bucket{le=...}`` /
+``_sum`` / ``_count`` triplet with CUMULATIVE bucket counts ending at
+``+Inf``. Family names are sanitized to the Prometheus grammar (dots and
+dashes become underscores) so tracer-style dotted names render scrapeable.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name: str) -> str:
+    if _NAME_OK.match(name):
+        return name
+    cleaned = _BAD_CHARS.sub("_", name)
+    if not cleaned or not _NAME_OK.match(cleaned[0]):
+        cleaned = "_" + cleaned
+    return cleaned
+
+
+def _fmt(value: float) -> str:
+    if value != value:  # NaN
+        return "NaN"
+    if value == math.inf:
+        return "+Inf"
+    if value == -math.inf:
+        return "-Inf"
+    if isinstance(value, int) or float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+def render(registry) -> str:
+    lines: list[str] = []
+    with registry._lock:
+        counters = sorted(registry._counters.values(), key=lambda c: c.name)
+        gauges = sorted(registry._gauges.values(), key=lambda g: g.name)
+        histograms = sorted(registry._histograms.values(), key=lambda h: h.name)
+    for c in counters:
+        name = sanitize(c.name)
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {_fmt(c.value)}")
+    for g in gauges:
+        name = sanitize(g.name)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_fmt(g.value)}")
+    for h in histograms:
+        name = sanitize(h.name)
+        # One locked copy per histogram: bucket/sum/count must describe
+        # the same moment (the format requires +Inf == count).
+        buckets, h_sum, h_count = h.exposition()
+        lines.append(f"# TYPE {name} histogram")
+        for bound, cumulative in buckets:
+            lines.append(f'{name}_bucket{{le="{_fmt(bound)}"}} {cumulative}')
+        lines.append(f"{name}_sum {_fmt(h_sum)}")
+        lines.append(f"{name}_count {h_count}")
+    return "\n".join(lines) + "\n"

@@ -18,6 +18,12 @@ Disabled by default: a disabled tracer's ``span`` is a no-op context manager
 and ``count``/``event`` return immediately (one attribute check), so the hot
 path pays nothing until someone calls ``tracer.enable()``.
 
+For the always-on production layer — Prometheus-style metrics families,
+decision-latency histograms, scrape endpoints, and the flight recorder —
+see :mod:`hashgraph_tpu.obs`; it layers on this tracer
+(:func:`~hashgraph_tpu.obs.observed_span` feeds both) rather than
+replacing it.
+
 Well-known counter families (all emitted through the process-wide default
 tracer unless a component was given its own):
 
@@ -39,10 +45,20 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
+import tempfile
 import threading
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
+
+
+# Process umask, probed ONCE at import (imports run before worker threads
+# exist): export_jsonl needs it to restore normal file modes on its mkstemp
+# temp files, and toggling the process-global umask per export would race
+# with concurrent file creation elsewhere (WAL segments, flight dumps).
+_UMASK = os.umask(0)
+os.umask(_UMASK)
 
 
 @dataclass
@@ -82,7 +98,13 @@ class Tracer:
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs):
-        """Time a block. Records wall duration; attrs are free-form."""
+        """Time a block. Records wall duration; attrs are free-form.
+
+        At most ``max_records`` span records are retained; past the cap the
+        per-span record is dropped (the ``span.dropped`` counter says how
+        many) while the ``span.<name>.calls`` / ``.ns`` counters keep
+        aggregating, so totals stay exact even when the record list is
+        full."""
         if not self.enabled:
             yield
             return
@@ -90,12 +112,21 @@ class Tracer:
         try:
             yield
         finally:
-            duration = time.perf_counter() - start
-            with self._lock:
-                if len(self._spans) < self._max_records:
-                    self._spans.append(SpanRecord(name, start, duration, attrs))
-                self._counters[f"span.{name}.calls"] += 1
-                self._counters[f"span.{name}.ns"] += int(duration * 1e9)
+            self.record_span(name, start, time.perf_counter() - start, attrs)
+
+    def record_span(
+        self, name: str, start: float, duration: float, attrs: dict
+    ) -> None:
+        """Record an externally-timed span (the body of :meth:`span`;
+        also used by :func:`hashgraph_tpu.obs.observed_span`, which times
+        once and feeds both the metrics registry and this tracer)."""
+        with self._lock:
+            if len(self._spans) < self._max_records:
+                self._spans.append(SpanRecord(name, start, duration, attrs))
+            else:
+                self._counters["span.dropped"] += 1
+            self._counters[f"span.{name}.calls"] += 1
+            self._counters[f"span.{name}.ns"] += int(duration * 1e9)
 
     def count(self, name: str, n: int = 1) -> None:
         if not self.enabled:
@@ -137,28 +168,52 @@ class Tracer:
         }
 
     def export_jsonl(self, path: str) -> None:
-        """Write counters, spans, and events as JSON lines."""
+        """Write counters, spans, and events as JSON lines.
+
+        Atomic: the lines are written to a temp file in the destination
+        directory and ``os.replace``d into place, so a crash (or a
+        serialization error) mid-export can never leave a torn trace file
+        — ``path`` either holds its previous content or the complete new
+        export."""
+        directory = os.path.dirname(os.path.abspath(path))
         with self._lock:
-            with open(path, "w") as fh:
-                fh.write(
-                    json.dumps({"type": "counters", "values": dict(self._counters)})
-                    + "\n"
-                )
-                for s in self._spans:
+            fd, tmp = tempfile.mkstemp(
+                prefix=os.path.basename(path) + ".", dir=directory
+            )
+            try:
+                # mkstemp creates 0600; restore the umask-derived mode a
+                # plain open() would have given, so downstream readers
+                # (log shippers under another uid) keep their access.
+                os.chmod(tmp, 0o666 & ~_UMASK)
+                with os.fdopen(fd, "w") as fh:
                     fh.write(
                         json.dumps(
-                            {
-                                "type": "span",
-                                "name": s.name,
-                                "start": s.start,
-                                "duration": s.duration,
-                                **s.attrs,
-                            }
+                            {"type": "counters", "values": dict(self._counters)}
                         )
                         + "\n"
                     )
-                for e in self._events:
-                    fh.write(json.dumps({"type": "event", **e}) + "\n")
+                    for s in self._spans:
+                        fh.write(
+                            json.dumps(
+                                {
+                                    "type": "span",
+                                    "name": s.name,
+                                    "start": s.start,
+                                    "duration": s.duration,
+                                    **s.attrs,
+                                }
+                            )
+                            + "\n"
+                        )
+                    for e in self._events:
+                        fh.write(json.dumps({"type": "event", **e}) + "\n")
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
 
 
 # Process-wide default tracer; engine instances use this unless given one.

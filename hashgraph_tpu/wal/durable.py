@@ -31,10 +31,13 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 import numpy as np
 
 from ..errors import StatusCode
+from ..obs import WAL_CHECKPOINTS_TOTAL, WAL_RECOVER_SECONDS, flight_recorder
+from ..obs import registry as default_registry
 from ..scope_config import ScopeConfig, ScopeConfigBuilder
 from ..wire import normalize_wire_votes
 from . import format as F
@@ -206,17 +209,44 @@ class DurableEngine:
         engine rejects as duplicates — so when unsure, pass a smaller
         ``after_lsn``.)"""
         with self._lock:
-            if storage is None:
-                return replay(
-                    self._wal.directory,
-                    self._engine,
-                    after_lsn=0 if after_lsn is None else after_lsn,
-                )
-            self._engine.load_from_storage(storage)
-            # after_lsn=None: skip records the latest snapshot covers
-            # (replay finds the watermark on a first metadata pass and
-            # streams the tail one segment at a time).
-            return replay(self._wal.directory, self._engine, after_lsn=after_lsn)
+            start = time.perf_counter()
+            # Replay-mode metrics gate (engines without one — this module
+            # is duck-typed — just replay unguarded): replayed decisions
+            # were made before the crash, so they must not feed the
+            # decision-latency histogram or re-count as fresh decisions.
+            set_mode = getattr(self._engine, "set_replay_mode", None)
+            if set_mode is not None:
+                set_mode(True)
+            try:
+                if storage is None:
+                    stats = replay(
+                        self._wal.directory,
+                        self._engine,
+                        after_lsn=0 if after_lsn is None else after_lsn,
+                    )
+                else:
+                    self._engine.load_from_storage(storage)
+                    # after_lsn=None: skip records the latest snapshot
+                    # covers (replay finds the watermark on a first
+                    # metadata pass and streams the tail one segment at a
+                    # time).
+                    stats = replay(
+                        self._wal.directory, self._engine, after_lsn=after_lsn
+                    )
+            finally:
+                if set_mode is not None:
+                    set_mode(False)
+            duration = time.perf_counter() - start
+            default_registry.histogram(WAL_RECOVER_SECONDS).observe(duration)
+            flight_recorder.record(
+                "wal.recover",
+                directory=self._wal.directory,
+                records=stats.records_applied,
+                errors=len(stats.errors),
+                segments_dropped=stats.segments_dropped,
+                seconds=round(duration, 6),
+            )
+            return stats
 
     # ── Proposal lifecycle ─────────────────────────────────────────────
 
@@ -526,6 +556,8 @@ class DurableEngine:
     def _save_and_mark(self, storage) -> tuple[int, int]:
         with self._lock:
             count = self._engine.save_to_storage(storage)
+            default_registry.counter(WAL_CHECKPOINTS_TOTAL).inc()
+            flight_recorder.record("wal.checkpoint", sessions=count)
             # Everything logged before the save is inside the snapshot
             # (mutators and the save both run under this lock). Sealing the
             # active segment first puts the whole covered history into

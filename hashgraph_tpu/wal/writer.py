@@ -28,7 +28,15 @@ from __future__ import annotations
 import errno
 import os
 import threading
+import time
+import weakref
 
+from ..obs import (
+    WAL_FSYNC_SECONDS,
+    WAL_SEGMENT_BYTES,
+    WAL_SEGMENT_COUNT,
+)
+from ..obs import registry as default_registry
 from ..tracing import tracer as default_tracer
 from . import format as F
 from .segment import (
@@ -135,6 +143,10 @@ class WalWriter:
             self._segment_size = valid_end
             self._next_lsn = last_lsn + 1
             self._file = open(path, "ab")
+            self._segment_count = len(segments)
+            self._total_bytes = valid_end + sum(
+                os.path.getsize(p) for _, p in segments[:-1]
+            )
         else:
             self._next_lsn = 1
             self._segment_base = 1
@@ -142,6 +154,27 @@ class WalWriter:
             self._file = open(
                 os.path.join(self._dir, segment_name(1)), "ab"
             )
+            self._segment_count = 1
+            self._total_bytes = 0
+        # Scrape-time gauges for this writer's on-disk footprint; providers
+        # sum across writers (one per durable peer), are unregistered on
+        # close, and hold only a weakref so an abandoned writer can still
+        # be collected.
+        self._m_fsync = default_registry.histogram(WAL_FSYNC_SECONDS)
+        ref = weakref.ref(self)
+
+        def _segments() -> int:
+            writer = ref()
+            return writer._segment_count if writer is not None else 0
+
+        def _bytes() -> int:
+            writer = ref()
+            return writer._total_bytes if writer is not None else 0
+
+        self._gauge_handles = [
+            default_registry.register_gauge(WAL_SEGMENT_COUNT, _segments, owner=self),
+            default_registry.register_gauge(WAL_SEGMENT_BYTES, _bytes, owner=self),
+        ]
         # The directory entries created above (the dir itself, the lock
         # file, a possibly-new active segment) must be durable before any
         # append is acknowledged.
@@ -192,6 +225,7 @@ class WalWriter:
             self._file.flush()
             self._next_lsn = lsn + 1
             self._segment_size += len(frame)
+            self._total_bytes += len(frame)
             self._tracer.count("wal.append_records")
             self._tracer.count("wal.append_bytes", len(frame))
             self._since_fsync += 1
@@ -239,6 +273,8 @@ class WalWriter:
             self._file.close()
             self._lock_file.close()  # releases the cross-process flock
             self._closed = True
+            for handle in self._gauge_handles:
+                handle.unregister()
 
     def __enter__(self) -> "WalWriter":
         return self
@@ -261,8 +297,14 @@ class WalWriter:
             removed = 0
             for (base, path), (next_base, _) in zip(segments, segments[1:]):
                 if next_base - 1 <= watermark:
+                    try:
+                        dropped_bytes = os.path.getsize(path)
+                    except OSError:
+                        dropped_bytes = 0
                     os.remove(path)
                     removed += 1
+                    self._segment_count -= 1
+                    self._total_bytes -= dropped_bytes
             if removed:
                 self._tracer.count("wal.compact.segments", removed)
             return removed
@@ -271,7 +313,11 @@ class WalWriter:
 
     def _fsync_locked(self) -> None:
         self._file.flush()
+        start = time.perf_counter()
         os.fsync(self._file.fileno())
+        # wal_fsync_seconds is THE durability/throughput dial's price tag:
+        # one observation per fsync syscall, always on.
+        self._m_fsync.observe(time.perf_counter() - start)
         self._tracer.count("wal.fsync")
         self._since_fsync = 0
 
@@ -283,6 +329,7 @@ class WalWriter:
         self._file.close()
         self._segment_base = self._next_lsn
         self._segment_size = 0
+        self._segment_count += 1
         self._file = open(
             os.path.join(self._dir, segment_name(self._segment_base)), "ab"
         )

@@ -1,0 +1,538 @@
+"""Observability subsystem (hashgraph_tpu.obs): metrics registry,
+Prometheus exposition, proposal timelines, flight recorder, the HTTP
+sidecar, and the bridge GET_METRICS opcode.
+
+The registry unit tests use FRESH MetricsRegistry instances (the process
+default accumulates across the whole test session by design); engine-level
+tests assert deltas or per-proposal readouts, never absolute global
+counter values.
+"""
+
+import json
+import math
+import threading
+import urllib.request
+
+import pytest
+
+from hashgraph_tpu import CreateProposalRequest, build_vote
+from hashgraph_tpu.bridge import BridgeClient, BridgeError, BridgeServer
+from hashgraph_tpu.engine import TpuConsensusEngine
+from hashgraph_tpu.obs import (
+    DECISION_LATENCY,
+    DECISIONS_TOTAL,
+    TIMEOUTS_FIRED_TOTAL,
+    FlightRecorder,
+    MetricsRegistry,
+    MetricsSidecar,
+    log_buckets,
+)
+from hashgraph_tpu.obs import flight_recorder as global_flight
+from hashgraph_tpu.obs import registry as global_registry
+from hashgraph_tpu.obs.prometheus import sanitize
+
+from common import NOW, random_stub_signer
+
+
+def fresh_engine(**kwargs) -> TpuConsensusEngine:
+    kwargs.setdefault("capacity", 8)
+    kwargs.setdefault("voter_capacity", 8)
+    return TpuConsensusEngine(random_stub_signer(), **kwargs)
+
+
+def make_request(expected: int = 2, expiry: int = 100) -> CreateProposalRequest:
+    return CreateProposalRequest("p", b"", b"o", expected, expiry, True)
+
+
+class TestRegistry:
+    def test_counter(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(4)
+        assert reg.counter("c").value == 5
+
+    def test_gauge_set_and_providers_sum(self):
+        reg = MetricsRegistry()
+        reg.gauge("g").set(2)
+        reg.register_gauge("g", lambda: 3)
+        reg.register_gauge("g", lambda: 5)
+        assert reg.gauge("g").value == 10
+
+    def test_gauge_provider_dies_with_owner(self):
+        reg = MetricsRegistry()
+
+        class Owner:
+            pass
+
+        owner = Owner()
+        reg.register_gauge("g", lambda: 7, owner=owner)
+        assert reg.gauge("g").value == 7
+        del owner
+        assert reg.gauge("g").value == 0
+
+    def test_gauge_unregister_handle(self):
+        reg = MetricsRegistry()
+        handle = reg.register_gauge("g", lambda: 7)
+        handle.unregister()
+        assert reg.gauge("g").value == 0
+
+    def test_gauge_provider_exception_does_not_poison(self):
+        reg = MetricsRegistry()
+        reg.register_gauge("g", lambda: 1 / 0)
+        reg.register_gauge("g", lambda: 3)
+        assert reg.gauge("g").value == 3
+
+    def test_log_buckets(self):
+        bounds = log_buckets(1e-3, 1.0, factor=10)
+        assert bounds == (1e-3, 1e-2, 1e-1, 1.0)
+        with pytest.raises(ValueError):
+            log_buckets(0, 1)
+
+    def test_histogram_buckets_cumulative(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", bounds=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 3.0, 100.0):
+            h.observe(v)
+        buckets = h.buckets()
+        assert buckets == [(1.0, 1), (2.0, 2), (4.0, 3), (math.inf, 4)]
+        assert h.count == 4
+        assert h.sum == pytest.approx(105.0)
+
+    def test_histogram_quantiles(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", bounds=log_buckets(1e-3, 10.0))
+        for _ in range(100):
+            h.observe(0.01)
+        # All mass in the bucket containing 0.01: the quantile estimate
+        # must land inside that bucket's bounds.
+        p50 = h.quantile(0.5)
+        assert 0.004 <= p50 <= 0.016
+        snap = h.snapshot()
+        assert snap["count"] == 100
+        assert snap["p50"] == pytest.approx(p50)
+
+    def test_histogram_empty_quantile(self):
+        assert MetricsRegistry().histogram("h").quantile(0.99) == 0.0
+
+    def test_histogram_bounds_mismatch_raises(self):
+        reg = MetricsRegistry()
+        reg.histogram("h", bounds=(1.0, 2.0))
+        assert reg.histogram("h").bounds == (1.0, 2.0)  # no bounds: reuse
+        assert reg.histogram("h", bounds=(1.0, 2.0)) is reg.histogram("h")
+        with pytest.raises(ValueError):
+            reg.histogram("h", bounds=(1.0, 4.0))
+
+    def test_concurrent_writers(self):
+        reg = MetricsRegistry()
+        counter = reg.counter("c")
+        hist = reg.histogram("h", bounds=(1.0, 10.0))
+        threads = [
+            threading.Thread(
+                target=lambda: [
+                    (counter.inc(), hist.observe(0.5)) for _ in range(5_000)
+                ]
+            )
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert counter.value == 40_000
+        assert hist.count == 40_000
+        assert hist.buckets()[0] == (1.0, 40_000)
+
+    def test_snapshot_shape(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        reg.gauge("g").set(2)
+        reg.histogram("h").observe(0.1)
+        snap = reg.snapshot()
+        assert snap["counters"] == {"c": 1}
+        assert snap["gauges"] == {"g": 2.0}
+        assert set(snap["histograms"]["h"]) == {"count", "sum", "p50", "p90", "p99"}
+        json.dumps(snap)  # must be JSON-serializable as-is
+
+
+class TestPrometheusRender:
+    def test_render_families(self):
+        reg = MetricsRegistry()
+        reg.counter("requests_total").inc(3)
+        reg.gauge("live").set(2)
+        h = reg.histogram("latency_seconds", bounds=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        text = reg.render_prometheus()
+        assert "# TYPE requests_total counter\nrequests_total 3" in text
+        assert "# TYPE live gauge\nlive 2" in text
+        assert '# TYPE latency_seconds histogram' in text
+        assert 'latency_seconds_bucket{le="0.1"} 1' in text
+        assert 'latency_seconds_bucket{le="1"} 1' in text
+        assert 'latency_seconds_bucket{le="+Inf"} 2' in text
+        assert "latency_seconds_count 2" in text
+
+    def test_inf_bucket_equals_count(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", bounds=(1.0,))
+        for v in (0.5, 2.0, 3.0):
+            h.observe(v)
+        assert h.buckets()[-1] == (math.inf, 3) and h.count == 3
+
+    def test_sanitize(self):
+        assert sanitize("wal.fsync-seconds") == "wal_fsync_seconds"
+        assert sanitize("engine.votes_in") == "engine_votes_in"
+        assert sanitize("9lives") == "_9lives"
+
+
+class TestTimelines:
+    def test_create_vote_decide(self):
+        engine = fresh_engine()
+        pid = engine.create_proposal("s", make_request(2), NOW).proposal_id
+        tl = engine.proposal_timeline("s", pid)
+        assert tl["created_at"] == NOW
+        assert tl["outcome"] is None and tl["first_vote_at"] is None
+
+        hist = engine.metrics.histogram(DECISION_LATENCY)
+        before = hist.count
+        for _ in range(2):
+            vote = build_vote(
+                engine.get_proposal("s", pid), True, random_stub_signer(), NOW + 1
+            )
+            engine.ingest_votes([("s", vote)], NOW + 1)
+        tl = engine.proposal_timeline("s", pid)
+        assert tl["first_vote_at"] == NOW + 1
+        assert tl["quorum_at"] == NOW + 1  # vote quorum = decision moment
+        assert tl["decided_at"] == NOW + 1
+        assert tl["outcome"] == "yes" and not tl["by_timeout"]
+        assert tl["decision_latency_s"] >= 0
+        assert hist.count == before + 1
+
+    def test_timeout_outcome(self):
+        engine = fresh_engine()
+        pid = engine.create_proposal("s", make_request(3), NOW).proposal_id
+        vote = build_vote(
+            engine.get_proposal("s", pid), True, random_stub_signer(), NOW + 1
+        )
+        engine.ingest_votes([("s", vote)], NOW + 1)
+        engine.sweep_timeouts(NOW + 200)
+        tl = engine.proposal_timeline("s", pid)
+        assert tl["by_timeout"] is True
+        assert tl["quorum_at"] is None  # no quorum ever reached
+        assert tl["outcome"] in ("yes", "no", "failed")
+
+    def test_pre_decided_session_has_no_fabricated_latency(self):
+        """A proposal that arrives already decided (vote-carrying gossip)
+        stamps its outcome but neither observes nor reports a decision
+        latency — the wall stamps would measure load time."""
+        sender = fresh_engine()
+        pid = sender.create_proposal("s", make_request(2), NOW).proposal_id
+        for _ in range(2):
+            vote = build_vote(
+                sender.get_proposal("s", pid), True, random_stub_signer(), NOW + 1
+            )
+            sender.ingest_votes([("s", vote)], NOW + 1)
+        decided_proposal = sender.get_proposal("s", pid)
+
+        receiver = fresh_engine()
+        hist = receiver.metrics.histogram(DECISION_LATENCY)
+        before = hist.count
+        receiver.process_incoming_proposal("s", decided_proposal, NOW + 2)
+        tl = receiver.proposal_timeline("s", pid)
+        assert tl["outcome"] == "yes" and tl["pre_decided"] is True
+        assert "decision_latency_s" not in tl
+        assert hist.count == before
+
+    def test_idempotent_timeout_not_counted(self):
+        """handle_consensus_timeout on an already-decided session returns
+        the result idempotently and must NOT inflate the fired counter."""
+        engine = fresh_engine()
+        pid = engine.create_proposal("s", make_request(2), NOW).proposal_id
+        for _ in range(2):
+            vote = build_vote(
+                engine.get_proposal("s", pid), True, random_stub_signer(), NOW + 1
+            )
+            engine.ingest_votes([("s", vote)], NOW + 1)
+        counter = engine.metrics.counter(TIMEOUTS_FIRED_TOTAL)
+        before = counter.value
+        assert engine.handle_consensus_timeout("s", pid, NOW + 200) is True
+        assert counter.value == before
+
+    def test_survives_delete_scope(self):
+        engine = fresh_engine()
+        pid = engine.create_proposal("s", make_request(2), NOW).proposal_id
+        engine.delete_scope("s")
+        tl = engine.proposal_timeline("s", pid)
+        assert tl is not None and tl["proposal_id"] == pid
+
+    def test_unknown_proposal(self):
+        assert fresh_engine().proposal_timeline("s", 12345) is None
+
+    def test_wal_replay_does_not_pollute_decision_metrics(self, tmp_path):
+        """Recovery replays pre-crash decisions at replay speed; they must
+        not feed the decision-latency histogram or re-count as fresh
+        decisions (they were made before the crash)."""
+        from hashgraph_tpu import DurableEngine
+
+        durable = DurableEngine(
+            fresh_engine(), str(tmp_path / "wal"), fsync_policy="off"
+        )
+        pid = durable.create_proposal("s", make_request(2), NOW).proposal_id
+        for _ in range(2):
+            vote = build_vote(
+                durable.get_proposal("s", pid), True, random_stub_signer(), NOW + 1
+            )
+            durable.ingest_votes([("s", vote)], NOW + 1)
+        durable.close()
+
+        restarted = DurableEngine(
+            fresh_engine(), str(tmp_path / "wal"), fsync_policy="off"
+        )
+        hist = restarted.engine.metrics.histogram(DECISION_LATENCY)
+        counter = restarted.engine.metrics.counter(DECISIONS_TOTAL)
+        before_hist, before_count = hist.count, counter.value
+        restarted.recover()
+        assert restarted.get_consensus_result("s", pid) is True
+        assert hist.count == before_hist
+        assert counter.value == before_count
+        tl = restarted.proposal_timeline("s", pid)
+        assert tl["outcome"] == "yes" and tl["pre_decided"] is True
+        assert "decision_latency_s" not in tl
+        # Replay mode is OFF again: a fresh post-recovery decision counts.
+        pid2 = restarted.create_proposal("s", make_request(2), NOW + 2).proposal_id
+        for _ in range(2):
+            vote = build_vote(
+                restarted.get_proposal("s", pid2), True, random_stub_signer(), NOW + 3
+            )
+            restarted.ingest_votes([("s", vote)], NOW + 3)
+        assert hist.count == before_hist + 1
+        assert counter.value == before_count + 1
+        restarted.close()
+
+    def test_columnar_path_stamps_timeline(self):
+        engine = fresh_engine(capacity=8, voter_capacity=4)
+        engine.scope("s").with_threshold(1.0).initialize()
+        import numpy as np
+
+        pid = engine.create_proposal("s", make_request(2), NOW).proposal_id
+        gids = np.array(
+            [engine.voter_gid(bytes([i + 1]) * 20) for i in range(2)], np.int64
+        )
+        statuses = engine.ingest_columnar(
+            "s",
+            np.full(2, pid, np.int64),
+            gids,
+            np.ones(2, bool),
+            NOW + 1,
+        )
+        assert int(statuses.sum()) == 0  # all OK
+        tl = engine.proposal_timeline("s", pid)
+        assert tl["first_vote_at"] == NOW + 1
+        assert tl["outcome"] == "yes"
+
+
+class TestFlightRecorder:
+    def test_bounded_ring(self):
+        recorder = FlightRecorder(capacity=4)
+        for i in range(10):
+            recorder.record("e", i=i)
+        events = recorder.events()
+        assert len(events) == 4
+        assert [attrs["i"] for _, _, attrs in events] == [6, 7, 8, 9]
+
+    def test_dump_jsonl(self, tmp_path):
+        recorder = FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+        recorder.record("boom", detail="x", weird=object())
+        path = recorder.dump("test-fault")
+        lines = [
+            json.loads(line)
+            for line in open(path).read().splitlines()
+        ]
+        assert lines[0]["type"] == "flight_header"
+        assert lines[0]["reason"] == "test-fault"
+        assert lines[1]["kind"] == "boom" and lines[1]["detail"] == "x"
+        assert "object object" in lines[1]["weird"]  # repr()d, not crashed
+
+    def test_dump_throttled(self, tmp_path):
+        recorder = FlightRecorder(
+            capacity=8, dump_dir=str(tmp_path), min_dump_interval=3600
+        )
+        recorder.record("e")
+        assert recorder.dump("first") is not None
+        assert recorder.dump("second") is None  # throttled
+        # An explicit path bypasses throttling (embedder asked).
+        explicit = str(tmp_path / "explicit.jsonl")
+        assert recorder.dump("third", path=explicit) == explicit
+
+    def test_explicit_dump_does_not_consume_throttle(self, tmp_path):
+        """A periodic explicit-path dump must not suppress the next real
+        fault's automatic dump."""
+        recorder = FlightRecorder(
+            capacity=8, dump_dir=str(tmp_path), min_dump_interval=3600
+        )
+        recorder.record("e")
+        assert recorder.dump("periodic", path=str(tmp_path / "p.jsonl"))
+        assert recorder.dump("real-fault") is not None
+
+    def test_dump_never_raises_on_unwritable_dir(self, tmp_path):
+        """The dump runs on fault paths: an unwritable destination must
+        yield None, never a second exception shadowing the original."""
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("file where a directory is needed")
+        recorder = FlightRecorder(
+            capacity=8, dump_dir=str(blocker / "sub")
+        )
+        recorder.record("e")
+        assert recorder.dump("fault") is None
+
+    def test_engine_fault_dumps(self, tmp_path, monkeypatch):
+        engine = fresh_engine()
+        pid = engine.create_proposal("s", make_request(2), NOW).proposal_id
+        vote = build_vote(
+            engine.get_proposal("s", pid), True, random_stub_signer(), NOW
+        )
+        monkeypatch.setenv("HASHGRAPH_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setattr(global_flight, "_last_dump", 0.0)
+
+        def boom(*args, **kwargs):
+            raise RuntimeError("pool died")
+
+        monkeypatch.setattr(engine._pool, "ingest", boom)
+        with pytest.raises(RuntimeError):
+            engine.ingest_votes([("s", vote)], NOW + 1)
+        dumps = list(tmp_path.glob("flight-*.jsonl"))
+        assert dumps, "engine fault did not produce a flight dump"
+        content = dumps[0].read_text()
+        assert "engine.fault" in content
+        assert "pool died" in content
+        # The ring's recent history (the ingest attempt) is in the dump.
+        assert "engine.ingest_votes" in content
+
+
+class TestSidecar:
+    def test_metrics_and_healthz(self):
+        reg = MetricsRegistry()
+        reg.counter("smoke_total").inc(2)
+        sidecar = MetricsSidecar(reg, health_fn=lambda: {"ok": True, "n": 1})
+        host, port = sidecar.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=5
+            ) as response:
+                assert response.headers["Content-Type"].startswith("text/plain")
+                text = response.read().decode()
+            assert "smoke_total 2" in text
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=5
+            ) as response:
+                assert json.loads(response.read()) == {"ok": True, "n": 1}
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"http://{host}:{port}/nope", timeout=5)
+            assert err.value.code == 404
+        finally:
+            sidecar.stop()
+
+    def test_unhealthy_is_503(self):
+        sidecar = MetricsSidecar(
+            MetricsRegistry(), health_fn=lambda: {"ok": False}
+        )
+        host, port = sidecar.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"http://{host}:{port}/healthz", timeout=5)
+            assert err.value.code == 503
+        finally:
+            sidecar.stop()
+
+
+class TestBridgeObservability:
+    def test_sidecar_and_get_metrics_opcode(self):
+        with BridgeServer(capacity=16, voter_capacity=8, metrics_port=0) as server:
+            host, port = server.metrics_address
+            with BridgeClient(*server.address) as client:
+                peer, _ = client.add_peer()
+                pid, _ = client.create_proposal(
+                    peer, "obs", NOW, "p", b"", 2, 100
+                )
+                client.cast_vote(peer, "obs", pid, True, NOW + 1)
+                with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=5
+                ) as response:
+                    text = response.read().decode()
+                for family in (
+                    "hashgraph_decision_latency_seconds_bucket",
+                    "hashgraph_ingest_batch_size_bucket",
+                    "hashgraph_live_proposals",
+                    "bridge_requests_total",
+                ):
+                    assert family in text, family
+                with urllib.request.urlopen(
+                    f"http://{host}:{port}/healthz", timeout=5
+                ) as response:
+                    health = json.loads(response.read())
+                assert health["ok"] is True and health["peers"] >= 1
+                # The identical exposition over the bridge wire.
+                wire_text = client.get_metrics()
+                assert "hashgraph_decision_latency_seconds_bucket" in wire_text
+                assert "bridge_requests_total" in wire_text
+        # Sidecar is down after stop().
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://{host}:{port}/healthz", timeout=1)
+
+    def test_sidecar_bind_failure_releases_bridge_listener(self):
+        """A metrics-port conflict in start() must not leave a half-started
+        server holding the bridge port (with-statement never reaches
+        stop() when __enter__ raises)."""
+        import socket
+
+        blocker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        taken_port = blocker.getsockname()[1]
+        try:
+            server = BridgeServer(
+                capacity=8, voter_capacity=8, metrics_port=taken_port
+            )
+            with pytest.raises(OSError):
+                server.start()
+            assert server._running is False and server._listener is None
+            # The same object can start cleanly afterwards.
+            server._metrics_port = 0
+            server.start()
+            try:
+                with BridgeClient(*server.address) as client:
+                    assert client.ping() >= 1
+            finally:
+                server.stop()
+        finally:
+            blocker.close()
+
+    def test_requests_counter_advances(self):
+        before = global_registry.counter("bridge_requests_total").value
+        with BridgeServer(capacity=8, voter_capacity=8) as server:
+            with BridgeClient(*server.address) as client:
+                client.ping()
+                client.ping()
+        assert global_registry.counter("bridge_requests_total").value >= before + 2
+
+    def test_dispatch_fault_dumps_flight(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HASHGRAPH_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setattr(global_flight, "_last_dump", 0.0)
+        with BridgeServer(capacity=8, voter_capacity=8) as server:
+            with BridgeClient(*server.address) as client:
+                peer, _ = client.add_peer()
+
+                def killed(*args, **kwargs):
+                    raise RuntimeError("peer engine killed mid-run")
+
+                server._peers[peer].engine.create_proposal = killed
+                with pytest.raises(BridgeError) as err:
+                    client.create_proposal(peer, "s", NOW, "p", b"", 2, 100)
+                assert err.value.status == 250  # STATUS_INTERNAL
+        dumps = sorted(tmp_path.glob("flight-*.jsonl"))
+        assert dumps, "bridge dispatch fault did not produce a flight dump"
+        content = "".join(p.read_text() for p in dumps)
+        assert "bridge.dispatch_error" in content
+        assert "peer engine killed mid-run" in content
+        # The events leading up to the fault (the ADD_PEER and the fatal
+        # dispatch) are in the ring.
+        assert "bridge.op" in content

@@ -1,12 +1,32 @@
 """Tracing: counters, spans, export, and engine instrumentation."""
 
 import json
+import threading
+
+import pytest
 
 from hashgraph_tpu.engine import TpuConsensusEngine
 from hashgraph_tpu.tracing import Tracer
 from hashgraph_tpu import CreateProposalRequest, build_vote
 
 from common import NOW, random_stub_signer
+
+
+class _PoisonLock:
+    """Lock stand-in that fails the test if the hot path ever acquires it
+    — the disabled tracer's span/count/event must be one attribute check."""
+
+    def __enter__(self):
+        raise AssertionError("disabled tracer touched its lock")
+
+    def __exit__(self, *exc):
+        raise AssertionError("disabled tracer touched its lock")
+
+    def acquire(self, *args, **kwargs):
+        raise AssertionError("disabled tracer touched its lock")
+
+    def release(self):
+        raise AssertionError("disabled tracer touched its lock")
 
 
 class TestTracer:
@@ -28,6 +48,59 @@ class TestTracer:
         assert stats["total"] > 0
         assert t.counters()["items"] == 3
         assert t.counters()["span.work.calls"] == 1
+
+    def test_disabled_overhead_no_lock(self):
+        """Disabled-tracer smoke test: span/count/event must never reach
+        the lock (one ``enabled`` attribute check and out)."""
+        t = Tracer()
+        t._lock = _PoisonLock()
+        for _ in range(1_000):
+            with t.span("hot"):
+                pass
+            t.count("c")
+            t.event("e")
+
+    def test_span_drop_counter_past_cap(self):
+        t = Tracer(enabled=True, max_records=2)
+        for _ in range(5):
+            with t.span("work"):
+                pass
+        assert len(t.spans("work")) == 2  # capped
+        counters = t.counters()
+        assert counters["span.dropped"] == 3
+        assert counters["span.work.calls"] == 5  # totals stay exact
+
+    def test_concurrent_counters_and_spans(self):
+        t = Tracer(enabled=True)
+
+        def hammer():
+            for _ in range(2_000):
+                t.count("c")
+                with t.span("s"):
+                    pass
+
+        threads = [threading.Thread(target=hammer) for _ in range(8)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        counters = t.counters()
+        assert counters["c"] == 16_000
+        assert counters["span.s.calls"] == 16_000
+
+    def test_export_jsonl_atomic_on_failure(self, tmp_path):
+        """A failing export (unserializable event attr) must leave the
+        previous trace file byte-identical and no temp litter behind."""
+        path = tmp_path / "trace.jsonl"
+        t = Tracer(enabled=True)
+        t.count("good", 1)
+        t.export_jsonl(str(path))
+        original = path.read_bytes()
+        t.event("bad", payload=object())  # json.dumps will raise
+        with pytest.raises(TypeError):
+            t.export_jsonl(str(path))
+        assert path.read_bytes() == original
+        assert list(tmp_path.iterdir()) == [path]  # no stray temp files
 
     def test_export_jsonl(self, tmp_path):
         t = Tracer(enabled=True)
