@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench metrics-smoke
+.PHONY: verify tier1 dev-install test bench metrics-smoke trace-smoke smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -32,3 +32,13 @@ bench:
 # present. See examples/metrics_smoke.py.
 metrics-smoke:
 	JAX_PLATFORMS=cpu python examples/metrics_smoke.py
+
+# End-to-end distributed-tracing check: two bridge peers decide one
+# proposal with trace context on the wire; per-peer span dumps stitch
+# into one Chrome/Perfetto trace (shared trace_id, causal order) and
+# EXPLAIN reports the quorum arithmetic. See examples/trace_smoke.py.
+trace-smoke:
+	JAX_PLATFORMS=cpu python examples/trace_smoke.py
+
+# Aggregate observability smoke: everything above in one target.
+smoke: metrics-smoke trace-smoke

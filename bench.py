@@ -28,7 +28,10 @@ registry (:mod:`hashgraph_tpu.obs` — counter totals, gauges, and histogram
 quantiles such as ``wal_fsync_seconds`` p50/p90/p99) into the emitted JSON
 and writes the full result object to PATH. ``--metrics-port N`` serves the
 HTTP ``/metrics`` + ``/healthz`` sidecar for the run's duration so the
-histograms can be scraped live while the bench executes.
+histograms can be scraped live while the bench executes. ``--trace-out
+PATH`` runs the bench under one distributed trace context and exports the
+context-tagged spans (device ingest, verify batches, WAL fsyncs, per-
+proposal lifecycles) as a Chrome trace-event file for Perfetto.
 
 Traces are pre-validated replays (signature/hash verification is the
 pluggable host stage — measured separately by ``python bench.py crypto``
@@ -1349,6 +1352,29 @@ if __name__ == "__main__":
 
     metrics_out = _pop_flag("--metrics-out")
 
+    # --trace-out PATH: run the whole bench under one distributed trace
+    # context (so every observed_span — device ingest, verify batches,
+    # WAL fsyncs — lands context-tagged in the trace store) and export a
+    # Chrome trace-event file Perfetto opens directly. Pair with a
+    # jax.profiler capture over the same window to correlate host spans
+    # with device timelines on one wall-clock axis.
+    trace_out = _pop_flag("--trace-out")
+    _trace_cm = None
+    if trace_out is not None:
+        from hashgraph_tpu.obs.trace import (
+            TraceContext,
+            trace_store,
+            use_context,
+        )
+
+        _root_ctx = TraceContext.generate()
+        _trace_cm = use_context(_root_ctx)
+        _trace_cm.__enter__()
+        print(
+            f"trace context {_root_ctx.to_traceparent()} -> {trace_out}",
+            file=sys.stderr,
+        )
+
     # --metrics-port N: serve /metrics + /healthz for the duration of the
     # run (0 = ephemeral; the bound address is printed to stderr so stdout
     # stays one JSON line), so `curl` can watch histograms fill live.
@@ -1386,35 +1412,59 @@ if __name__ == "__main__":
 
         return registry.snapshot()
 
-    if which == "all":
-        results = {}
-        for name in (
-            "engine",
-            "pool",
-            "config2",
-            "lanes1024",
-            "engine_lanes1024",
-            "validated",
-            "crypto",
-            "config4",
-            "engine_config4",
-            "config5",
-            "engine_config5",
-            "engine_config5_retained",
-        ):
-            results[name] = runners[name]()
-            print(json.dumps(results[name]))
-        if metrics_out is not None:
-            with open(metrics_out, "w") as fh:
-                json.dump(
-                    {"results": results, "metrics": _registry_snapshot()}, fh
+    # finally: a run that RAISES is exactly the one whose trace matters —
+    # the export (and sidecar shutdown) must survive runner failures.
+    try:
+        if which == "all":
+            results = {}
+            for name in (
+                "engine",
+                "pool",
+                "config2",
+                "lanes1024",
+                "engine_lanes1024",
+                "validated",
+                "crypto",
+                "config4",
+                "engine_config4",
+                "config5",
+                "engine_config5",
+                "engine_config5_retained",
+            ):
+                results[name] = runners[name]()
+                print(json.dumps(results[name]))
+            if metrics_out is not None:
+                with open(metrics_out, "w") as fh:
+                    json.dump(
+                        {"results": results, "metrics": _registry_snapshot()}, fh
+                    )
+        else:
+            result = runners[which]()
+            if metrics_out is not None:
+                result["metrics"] = _registry_snapshot()
+                with open(metrics_out, "w") as fh:
+                    json.dump(result, fh)
+            print(json.dumps(result))
+    finally:
+        # Cleanup steps are independent: a failing trace export must not
+        # mask the runner's real exception or skip the sidecar shutdown.
+        try:
+            if _trace_cm is not None:
+                _trace_cm.__exit__(None, None, None)
+                from hashgraph_tpu.obs.trace import trace_store
+
+                events = trace_store.export_chrome(trace_out)
+                dropped = (
+                    f" ({trace_store.dropped} spans dropped at the store cap)"
+                    if trace_store.dropped
+                    else ""
                 )
-    else:
-        result = runners[which]()
-        if metrics_out is not None:
-            result["metrics"] = _registry_snapshot()
-            with open(metrics_out, "w") as fh:
-                json.dump(result, fh)
-        print(json.dumps(result))
-    if sidecar is not None:
-        sidecar.stop()
+                print(
+                    f"wrote {events} trace events to {trace_out}{dropped}",
+                    file=sys.stderr,
+                )
+        except Exception as exc:
+            print(f"trace export failed: {exc!r}", file=sys.stderr)
+        finally:
+            if sidecar is not None:
+                sidecar.stop()

@@ -2,7 +2,11 @@
 
 Five fully separate peers (own storage, own bus, own keys) exchange wire
 bytes only — the pattern a real gossip transport implements
-(reference: tests/network_gossip_tests.rs). Run: python examples/gossip_simulation.py
+(reference: tests/network_gossip_tests.rs). The gossiped bytes carry a
+distributed trace context as a skippable protobuf field
+(:func:`hashgraph_tpu.obs.trace.attach_trace`): peers built without
+tracing decode the exact same messages, peers built with it stitch every
+delivery into one causal trace. Run: python examples/gossip_simulation.py
 """
 
 import random
@@ -18,6 +22,14 @@ from hashgraph_tpu import (
     Proposal,
     Vote,
 )
+from hashgraph_tpu.obs.trace import (
+    TraceContext,
+    attach_trace,
+    current_context,
+    extract_trace,
+    trace_store,
+    use_context,
+)
 
 N_PEERS = 5
 
@@ -31,7 +43,8 @@ def main() -> None:
     now = int(time.time())
     scope = "network"
 
-    # Peer 0 creates and broadcasts the proposal as wire bytes.
+    # Peer 0 creates and broadcasts the proposal as wire bytes, with the
+    # root trace context attached to the gossiped message itself.
     proposal = peers[0].create_proposal(
         scope,
         CreateProposalRequest(
@@ -41,34 +54,58 @@ def main() -> None:
         ),
         now,
     )
-    wire = proposal.encode()
-    for peer in peers[1:]:
-        peer.process_incoming_proposal(scope, Proposal.decode(wire), now)
+    root = TraceContext.generate()
+    trace_store.record(
+        "consensus.create_proposal", root, time.time(), 0.0, peer="peer-0",
+        attrs={"proposal_id": proposal.proposal_id},
+    )
+    wire = attach_trace(proposal.encode(), root)
+    for i, peer in enumerate(peers[1:], start=1):
+        # Activate the context the bytes travelled with — the idiom a
+        # receiving node wraps around its delivery handler (an engine, or
+        # any observed_span-instrumented layer, would auto-tag its spans;
+        # the scalar service records none, so the example stamps one).
+        with use_context(extract_trace(wire)):
+            peer.process_incoming_proposal(scope, Proposal.decode(wire), now)
+            ctx = current_context()
+            trace_store.record(
+                "consensus.process_proposal", ctx.child(), time.time(), 0.0,
+                parent=ctx.span_id, peer=f"peer-{i}",
+            )
     print(f"proposal {proposal.proposal_id} delivered to {N_PEERS} peers")
 
     # Everyone votes (peer 1 dissents -> 4 YES of 5, quorum is ceil(10/3)=4);
-    # votes gossip to all peers in RANDOM order.
+    # votes gossip to all peers in RANDOM order, trace context attached.
     mailbox: list[bytes] = []
     for i, peer in enumerate(peers):
         vote = peer.cast_vote(scope, proposal.proposal_id, i != 1, now)
-        mailbox.append(vote.encode())
+        mailbox.append(attach_trace(vote.encode(), root))
     rng.shuffle(mailbox)
 
     for raw in mailbox:
-        vote = Vote.decode(raw)
-        for i, peer in enumerate(peers):
-            if peer.signer().identity() == vote.vote_owner:
-                continue  # own vote already applied locally
-            peer.process_incoming_vote(scope, vote.clone(), now)
+        vote = Vote.decode(raw)  # the trace field is skipped by decoders
+        with use_context(extract_trace(raw)):
+            ctx = current_context()
+            for i, peer in enumerate(peers):
+                if peer.signer().identity() == vote.vote_owner:
+                    continue  # own vote already applied locally
+                peer.process_incoming_vote(scope, vote.clone(), now)
+                trace_store.record(
+                    "consensus.process_vote", ctx.child(), time.time(), 0.0,
+                    parent=ctx.span_id, peer=f"peer-{i}",
+                )
 
-    # All peers converge on the same result.
+    # All peers converge on the same result — and on the same trace.
     results = [
         peer.storage().get_consensus_result(scope, proposal.proposal_id)
         for peer in peers
     ]
     print("per-peer results:", results)
     assert len(set(results)) == 1, "peers diverged!"
+    traced_peers = {s.peer for s in trace_store.spans(trace_id=root.trace_id)}
+    assert len(traced_peers) == N_PEERS, traced_peers
     print(f"converged: consensus = {results[0]} (4 YES of {N_PEERS})")
+    print(f"one trace ({root.trace_id.hex()[:16]}…) spans {len(traced_peers)} peers")
 
 
 if __name__ == "__main__":

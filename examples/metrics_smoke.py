@@ -35,6 +35,8 @@ REQUIRED_FAMILIES = [
     "wal_segment_count",
     "hashgraph_live_proposals",
     "bridge_requests_total",
+    # Labelled info gauge: who/what is serving this scrape.
+    "hashgraph_build_info{",
 ]
 
 
@@ -68,6 +70,15 @@ def main() -> int:
                 missing = [f for f in REQUIRED_FAMILIES if f not in text]
                 assert not missing, f"missing families in /metrics: {missing}"
                 assert 'le="+Inf"' in text, "histogram missing +Inf bucket"
+                build_line = next(
+                    l for l in text.splitlines()
+                    if l.startswith("hashgraph_build_info{")
+                )
+                for label in ("version=", "jax=", "backend="):
+                    assert label in build_line, build_line
+                # The bridge server imported and ran JAX, so the backend
+                # label must name a real runtime, not a placeholder.
+                assert 'backend="not-loaded"' not in build_line, build_line
 
                 with urllib.request.urlopen(
                     f"http://{mhost}:{mport}/healthz", timeout=5

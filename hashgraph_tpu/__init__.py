@@ -72,6 +72,7 @@ from .types import (
 )
 from .obs import FlightRecorder, MetricsRegistry, MetricsSidecar
 from .obs import flight_recorder, registry as metrics_registry
+from .obs.trace import TraceContext, trace_store
 from .wal import DurableEngine, WalWriter
 from .wire import Proposal, Vote
 
@@ -85,8 +86,10 @@ __all__ = [
     "MetricsRegistry",
     "MetricsSidecar",
     "FlightRecorder",
+    "TraceContext",
     "metrics_registry",
     "flight_recorder",
+    "trace_store",
     "ConsensusService",
     "ConsensusStats",
     "ConsensusConfig",

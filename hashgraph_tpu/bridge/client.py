@@ -7,10 +7,12 @@ any language reproduces exactly these byte sequences.
 
 from __future__ import annotations
 
+import json
 import socket
 from dataclasses import dataclass
 
 from ..errors import StatusCode, error_for_code
+from ..obs.trace import TraceContext, current_context
 from . import protocol as P
 
 
@@ -36,9 +38,22 @@ class BridgeEvent:
 
 
 class BridgeClient:
+    """One bridge connection.
+
+    Distributed tracing: proposal-lifecycle calls accept an optional
+    ``trace=`` :class:`~hashgraph_tpu.obs.trace.TraceContext` (falling
+    back to the ambient :func:`~hashgraph_tpu.obs.trace.current_context`)
+    appended as the protocol's backward-compatible frame suffix.
+    ``create_proposal``/``cast_vote`` store the proposal's server-bound
+    context in :attr:`last_trace_context` — pass it as ``trace=`` when
+    ferrying the returned bytes to other peers so every peer's spans
+    stitch into one trace."""
+
     def __init__(self, host: str, port: int, timeout: float = 10.0):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        #: Trace context returned by the last create_proposal/cast_vote.
+        self.last_trace_context: TraceContext | None = None
 
     def close(self) -> None:
         self._sock.close()
@@ -65,6 +80,14 @@ class BridgeClient:
 
     # ── API ────────────────────────────────────────────────────────────
 
+    @staticmethod
+    def _suffix(trace: TraceContext | None) -> bytes:
+        """Explicit ``trace=`` wins; otherwise the ambient context (if
+        any); empty bytes keep the frame byte-identical to the old wire."""
+        return P.encode_trace_context(
+            trace if trace is not None else current_context()
+        )
+
     def ping(self) -> int:
         return self._call(P.OP_PING).u32()
 
@@ -86,8 +109,10 @@ class BridgeClient:
         expected_voters: int,
         rel_expiration: int,
         liveness_yes: bool = True,
+        trace: TraceContext | None = None,
     ) -> tuple[int, bytes]:
-        """Returns (proposal_id, proposal protobuf bytes)."""
+        """Returns (proposal_id, proposal protobuf bytes); the proposal's
+        bound trace context lands in :attr:`last_trace_context`."""
         cursor = self._call(
             P.OP_CREATE_PROPOSAL,
             P.u32(peer)
@@ -97,28 +122,69 @@ class BridgeClient:
             + P.blob(payload)
             + P.u32(expected_voters)
             + P.u64(rel_expiration)
-            + P.u8(1 if liveness_yes else 0),
+            + P.u8(1 if liveness_yes else 0)
+            + self._suffix(trace),
         )
-        return cursor.u32(), cursor.blob()
+        pid, blob = cursor.u32(), cursor.blob()
+        self.last_trace_context = P.read_trace_context(cursor)
+        return pid, blob
 
-    def cast_vote(self, peer: int, scope: str, pid: int, choice: bool, now: int) -> bytes:
-        """Returns the signed Vote protobuf bytes for gossiping."""
+    def cast_vote(
+        self,
+        peer: int,
+        scope: str,
+        pid: int,
+        choice: bool,
+        now: int,
+        trace: TraceContext | None = None,
+    ) -> bytes:
+        """Returns the signed Vote protobuf bytes for gossiping; the
+        proposal's bound trace context lands in :attr:`last_trace_context`."""
         cursor = self._call(
             P.OP_CAST_VOTE,
-            P.u32(peer) + P.string(scope) + P.u32(pid) + P.u8(1 if choice else 0) + P.u64(now),
+            P.u32(peer)
+            + P.string(scope)
+            + P.u32(pid)
+            + P.u8(1 if choice else 0)
+            + P.u64(now)
+            + self._suffix(trace),
         )
-        return cursor.blob()
+        blob = cursor.blob()
+        self.last_trace_context = P.read_trace_context(cursor)
+        return blob
 
-    def process_proposal(self, peer: int, scope: str, proposal: bytes, now: int) -> None:
+    def process_proposal(
+        self,
+        peer: int,
+        scope: str,
+        proposal: bytes,
+        now: int,
+        trace: TraceContext | None = None,
+    ) -> None:
         self._call(
             P.OP_PROCESS_PROPOSAL,
-            P.u32(peer) + P.string(scope) + P.u64(now) + P.blob(proposal),
+            P.u32(peer)
+            + P.string(scope)
+            + P.u64(now)
+            + P.blob(proposal)
+            + self._suffix(trace),
         )
 
-    def process_vote(self, peer: int, scope: str, vote: bytes, now: int) -> None:
+    def process_vote(
+        self,
+        peer: int,
+        scope: str,
+        vote: bytes,
+        now: int,
+        trace: TraceContext | None = None,
+    ) -> None:
         self._call(
             P.OP_PROCESS_VOTE,
-            P.u32(peer) + P.string(scope) + P.u64(now) + P.blob(vote),
+            P.u32(peer)
+            + P.string(scope)
+            + P.u64(now)
+            + P.blob(vote)
+            + self._suffix(trace),
         )
 
     # Soft ceiling per PROCESS_VOTES frame, comfortably under the server's
@@ -126,7 +192,12 @@ class BridgeClient:
     _VOTE_FRAME_BUDGET = 8 * 1024 * 1024
 
     def process_votes(
-        self, peer: int, scope: str, votes: list[bytes], now: int
+        self,
+        peer: int,
+        scope: str,
+        votes: list[bytes],
+        now: int,
+        trace: TraceContext | None = None,
     ) -> list[int]:
         """Batch delivery: one frame (chunked past ~8 MiB), per-vote
         StatusCode list back in batch order (0 OK / 28 ALREADY_REACHED are
@@ -145,14 +216,27 @@ class BridgeClient:
             chunk = votes[start:stop]
             payload = [P.u32(peer), P.string(scope), P.u64(now), P.u32(len(chunk))]
             payload.extend(P.blob(v) for v in chunk)
+            payload.append(self._suffix(trace))
             cursor = self._call(P.OP_PROCESS_VOTES, b"".join(payload))
             statuses.extend(cursor.raw(cursor.u32()))
             start = stop
         return statuses
 
-    def handle_timeout(self, peer: int, scope: str, pid: int, now: int) -> bool:
+    def handle_timeout(
+        self,
+        peer: int,
+        scope: str,
+        pid: int,
+        now: int,
+        trace: TraceContext | None = None,
+    ) -> bool:
         cursor = self._call(
-            P.OP_HANDLE_TIMEOUT, P.u32(peer) + P.string(scope) + P.u32(pid) + P.u64(now)
+            P.OP_HANDLE_TIMEOUT,
+            P.u32(peer)
+            + P.string(scope)
+            + P.u32(pid)
+            + P.u64(now)
+            + self._suffix(trace),
         )
         return bool(cursor.u8())
 
@@ -187,6 +271,18 @@ class BridgeClient:
         """(total, active, failed, reached)."""
         cursor = self._call(P.OP_GET_STATS, P.u32(peer) + P.string(scope))
         return cursor.u32(), cursor.u32(), cursor.u32(), cursor.u32()
+
+    def explain(self, peer: int, scope: str, pid: int) -> dict:
+        """Decision provenance for one proposal (``OP_EXPLAIN``): the
+        accepted vote chain with per-peer contributions, the quorum
+        arithmetic (required votes, yes/no/silent counts, decision rule),
+        lifecycle timeline, distributed-trace identity, and — for durable
+        peers — the WAL LSN watermark. Raises the usual wire-mapped
+        errors (e.g. SESSION_NOT_FOUND) for unknown proposals."""
+        cursor = self._call(
+            P.OP_EXPLAIN, P.u32(peer) + P.string(scope) + P.u32(pid)
+        )
+        return json.loads(cursor.blob().decode("utf-8"))
 
     def get_metrics(self) -> str:
         """Prometheus text-format scrape of the server process's metrics

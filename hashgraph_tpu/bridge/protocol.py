@@ -26,11 +26,26 @@ the reference's one-service-per-peer deployment, src/service.rs:26-29).
 Statuses: 0 = OK; 1..29 mirror :class:`hashgraph_tpu.errors.StatusCode`;
 240+ are bridge-level (unknown peer / malformed frame / unknown opcode /
 internal error). Error responses carry the message as a string payload.
+
+**Trace context (optional, backward compatible).** Proposal-lifecycle
+requests (CREATE_PROPOSAL, CAST_VOTE, PROCESS_PROPOSAL, PROCESS_VOTE,
+PROCESS_VOTES, HANDLE_TIMEOUT) may append a 26-byte trace-context suffix
+after their last field: ``u8 version (0)`` + the 25-byte
+:class:`~hashgraph_tpu.obs.trace.TraceContext` wire form (16-byte
+trace_id, 8-byte parent span_id, u8 flags). CREATE_PROPOSAL and
+CAST_VOTE responses append the same suffix carrying the proposal's bound
+context, so the embedder can ferry it to the peers it gossips to.
+Handlers never require the suffix (frames without it decode exactly as
+before) and never read past their declared fields, so old and new peers
+interoperate in both directions: an old server ignores the trailing
+bytes, an old client ignores the suffixed response tail.
 """
 
 from __future__ import annotations
 
 import struct
+
+from ..obs.trace import TRACE_WIRE_BYTES, TraceContext
 
 PROTOCOL_VERSION = 1
 
@@ -52,6 +67,10 @@ OP_PROCESS_VOTES = 11  # batch: u32 count + count vote blobs -> u8 statuses
 # byte blob — remote embedders scrape over the wire they already hold
 # instead of needing the HTTP sidecar reachable.
 OP_GET_METRICS = 12
+# Decision provenance: u32 peer_id + string scope + u32 proposal_id ->
+# one JSON blob (TpuConsensusEngine.explain_decision: vote chain, quorum
+# arithmetic, timeline phases, trace identity, WAL watermark).
+OP_EXPLAIN = 13
 
 # Bridge-level statuses (protocol StatusCode values occupy 0..29).
 STATUS_OK = 0
@@ -111,6 +130,9 @@ class Cursor:
     def done(self) -> bool:
         return self._pos == len(self._data)
 
+    def remaining(self) -> int:
+        return len(self._data) - self._pos
+
 
 def u8(v: int) -> bytes:
     return struct.pack("<B", v)
@@ -140,6 +162,37 @@ def blob(b: bytes) -> bytes:
 def encode_frame(lead: int, payload: bytes = b"") -> bytes:
     """``lead`` is the opcode (requests) or status (responses)."""
     return u32(1 + len(payload)) + u8(lead) + payload
+
+
+# ── Optional trace-context suffix ──────────────────────────────────────
+
+TRACE_SUFFIX_VERSION = 0
+
+
+def encode_trace_context(ctx: TraceContext | None) -> bytes:
+    """The 26-byte optional frame suffix (empty bytes for None, so call
+    sites can append unconditionally)."""
+    if ctx is None:
+        return b""
+    return u8(TRACE_SUFFIX_VERSION) + ctx.to_wire()
+
+
+def read_trace_context(c: Cursor) -> TraceContext | None:
+    """Consume a trailing trace-context suffix, if present. Returns None
+    for frames without one (old peers), with an unknown suffix version,
+    or with a short/odd-sized tail (future peers, foreign embedders
+    appending their own trailers — the bytes are consumed and ignored,
+    never an error, matching the pre-suffix server's tolerance)."""
+    if c.done():
+        return None
+    if c.remaining() < 1 + TRACE_WIRE_BYTES:
+        c.raw(c.remaining())
+        return None
+    version = c.u8()
+    raw = c.raw(TRACE_WIRE_BYTES)
+    if version != TRACE_SUFFIX_VERSION:
+        return None
+    return TraceContext.from_wire(raw)
 
 
 def read_exact(sock, n: int) -> bytes:

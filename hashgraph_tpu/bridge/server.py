@@ -16,8 +16,11 @@ reference's no-I/O contract (reference: src/lib.rs:15-34).
 
 from __future__ import annotations
 
+import contextlib
+import json
 import socket
 import threading
+import time
 from struct import error as struct_error
 
 from ..engine import TpuConsensusEngine
@@ -30,6 +33,7 @@ from ..obs import (
     flight_recorder,
 )
 from ..obs import registry as default_registry
+from ..obs.trace import trace_store, use_context
 from ..signing import ConsensusSignatureScheme
 from ..signing.ethereum import EthereumConsensusSigner
 from ..types import (
@@ -47,6 +51,29 @@ class _Peer:
         self.peer_id = peer_id
         self.engine = engine
         self.receiver = receiver
+
+
+@contextlib.contextmanager
+def _traced(name: str, ctx, peer_id: int):
+    """Activate a frame's trace context around its engine call and record
+    the bridge dispatch itself as a child span (no-op for untraced
+    frames, so the old wire stays zero-cost)."""
+    if ctx is None or not trace_store.enabled:
+        yield
+        return
+    start = time.time()
+    with use_context(ctx):
+        try:
+            yield
+        finally:
+            trace_store.record(
+                name,
+                ctx.child(),
+                start,
+                time.time() - start,
+                parent=ctx.span_id,
+                peer=f"bridge:{peer_id}",
+            )
 
 
 class BridgeServer:
@@ -433,6 +460,7 @@ class BridgeServer:
         expected_voters = c.u32()
         rel_expiration = c.u64()
         liveness = bool(c.u8())
+        ctx = P.read_trace_context(c)
         request = CreateProposalRequest(
             name=name,
             payload=payload,
@@ -441,29 +469,44 @@ class BridgeServer:
             expiration_timestamp=rel_expiration,
             liveness_criteria_yes=liveness,
         )
-        proposal = peer.engine.create_proposal(scope, request, now)
-        return P.STATUS_OK, P.u32(proposal.proposal_id) + P.blob(proposal.encode())
+        with _traced("bridge.create_proposal", ctx, peer.peer_id):
+            proposal = peer.engine.create_proposal(scope, request, now)
+        # Response suffix: the trace the engine bound (root, or child of
+        # the request's ctx) — the embedder ferries it with the gossip.
+        bound = peer.engine.trace_context_of(scope, proposal.proposal_id)
+        return P.STATUS_OK, (
+            P.u32(proposal.proposal_id)
+            + P.blob(proposal.encode())
+            + P.encode_trace_context(bound)
+        )
 
     def _op_cast_vote(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
         scope = c.string()
         pid = c.u32()
         choice = bool(c.u8())
         now = c.u64()
-        vote = peer.engine.cast_vote(scope, pid, choice, now)
-        return P.STATUS_OK, P.blob(vote.encode())
+        ctx = P.read_trace_context(c)
+        with _traced("bridge.cast_vote", ctx, peer.peer_id):
+            vote = peer.engine.cast_vote(scope, pid, choice, now)
+        bound = peer.engine.trace_context_of(scope, pid)
+        return P.STATUS_OK, P.blob(vote.encode()) + P.encode_trace_context(bound)
 
     def _op_process_proposal(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
         scope = c.string()
         now = c.u64()
         proposal = Proposal.decode(c.blob())
-        peer.engine.process_incoming_proposal(scope, proposal, now)
+        ctx = P.read_trace_context(c)
+        with _traced("bridge.process_proposal", ctx, peer.peer_id):
+            peer.engine.process_incoming_proposal(scope, proposal, now)
         return P.STATUS_OK, b""
 
     def _op_process_vote(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
         scope = c.string()
         now = c.u64()
         vote = Vote.decode(c.blob())
-        peer.engine.process_incoming_vote(scope, vote, now)
+        ctx = P.read_trace_context(c)
+        with _traced("bridge.process_vote", ctx, peer.peer_id):
+            peer.engine.process_incoming_vote(scope, vote, now)
         return P.STATUS_OK, b""
 
     def _op_process_votes(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
@@ -483,10 +526,12 @@ class BridgeServer:
                 decodable.append((i, Vote.decode(blob)))
             except (ValueError, IndexError):
                 pass  # per-vote 241 already set; the batch proceeds
+        ctx = P.read_trace_context(c)
         if decodable:
-            engine_statuses = peer.engine.ingest_votes(
-                [(scope, vote) for _, vote in decodable], now
-            )
+            with _traced("bridge.process_votes", ctx, peer.peer_id):
+                engine_statuses = peer.engine.ingest_votes(
+                    [(scope, vote) for _, vote in decodable], now
+                )
             for (i, _), status in zip(decodable, engine_statuses):
                 statuses[i] = int(status) & 0xFF
         return P.STATUS_OK, P.u32(count) + bytes(statuses)
@@ -495,7 +540,9 @@ class BridgeServer:
         scope = c.string()
         pid = c.u32()
         now = c.u64()
-        result = peer.engine.handle_consensus_timeout(scope, pid, now)
+        ctx = P.read_trace_context(c)
+        with _traced("bridge.handle_timeout", ctx, peer.peer_id):
+            result = peer.engine.handle_consensus_timeout(scope, pid, now)
         return P.STATUS_OK, P.u8(1 if result else 0)
 
     def _op_get_result(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
@@ -559,6 +606,16 @@ class BridgeServer:
             + P.u32(stats.consensus_reached)
         )
 
+    def _op_explain(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        """Decision provenance as one JSON blob (see
+        ``TpuConsensusEngine.explain_decision``); durable peers overlay
+        their WAL watermark. SessionNotFound maps to the usual wire
+        status through the dispatch loop."""
+        scope = c.string()
+        pid = c.u32()
+        verdict = peer.engine.explain_decision(scope, pid)
+        return P.STATUS_OK, P.blob(json.dumps(verdict).encode("utf-8"))
+
 
 _HANDLERS = {
     P.OP_CREATE_PROPOSAL: BridgeServer._op_create_proposal,
@@ -571,4 +628,5 @@ _HANDLERS = {
     P.OP_POLL_EVENTS: BridgeServer._op_poll_events,
     P.OP_GET_PROPOSAL: BridgeServer._op_get_proposal,
     P.OP_GET_STATS: BridgeServer._op_get_stats,
+    P.OP_EXPLAIN: BridgeServer._op_explain,
 }

@@ -61,6 +61,7 @@ from ..obs import (
 from ..obs import registry as default_registry
 from ..obs.registry import Counter
 from ..obs.timeline import OUTCOME_FAILED, OUTCOME_NO, OUTCOME_YES
+from ..obs.trace import TraceContext, current_context, trace_store
 from ..ops.decide import (
     STATE_ACTIVE,
     STATE_FAILED,
@@ -68,7 +69,11 @@ from ..ops.decide import (
     STATE_REACHED_YES,
 )
 from ..protocol import (
+    _F64_EPSILON,
+    _TWO_THIRDS,
     build_vote,
+    calculate_required_votes,
+    calculate_threshold_based_value,
     regenerate_until_unique,
     validate_proposal_timestamp,
     validate_vote,
@@ -179,6 +184,11 @@ class SessionRecord(Generic[Scope]):
     # the two paths back into true (call-granularity) arrival order.
     arrival_seq: int = 0
     scalar_seqs: list[int] = field(default_factory=list)
+    # Distributed trace identity bound at create/process time (None when
+    # the trace store is disabled or the session arrived via an untraced
+    # batch path): every later span/instant for this session joins this
+    # trace, and the wire layers serialize it alongside the proposal.
+    trace: "TraceContext | None" = None
 
     @classmethod
     def fresh_pooled(
@@ -200,6 +210,7 @@ class SessionRecord(Generic[Scope]):
         rec.retained_cache = None
         rec.arrival_seq = 0
         rec.scalar_seqs = []
+        rec.trace = None
         return rec
 
     def next_arrival_seq(self) -> int:
@@ -272,6 +283,10 @@ class TpuConsensusEngine(Generic[Scope]):
         else:
             self._process_zero = True
         self.tracer = default_tracer
+        # Distributed-trace peer label: spans this engine records are
+        # attributed to its signer identity, so one process hosting many
+        # bridge peers still yields per-peer stitched timelines.
+        self._trace_peer = "peer:" + signer.identity().hex()[:12]
         # Always-on metrics (process-wide registry). Instruments are
         # resolved once here so the per-batch hot paths pay attribute
         # loads, not registry dict probes.
@@ -385,6 +400,7 @@ class TpuConsensusEngine(Generic[Scope]):
     ) -> Proposal:
         """Create a local proposal and claim a pool slot
         (reference: src/service.rs:183-209)."""
+        wall0 = time.time()
         proposal = request.into_proposal(now)
         self._ensure_unique_pid(scope, proposal)
         # Same gauntlet the scalar service runs via from_proposal ->
@@ -392,8 +408,37 @@ class TpuConsensusEngine(Generic[Scope]):
         # keeps the error surface identical, reference: src/utils.rs:106-120).
         validate_proposal_timestamp(proposal.expiration_timestamp, now)
         resolved = self._resolve_config(scope, config, proposal)
-        self._register(scope, proposal, resolved, now)
+        record = self._register(scope, proposal, resolved, now)
+        if trace_store.enabled:
+            self._bind_trace(
+                record, "consensus.create_proposal", scope, wall0
+            )
         return proposal.clone()
+
+    def _bind_trace(
+        self, record: "SessionRecord[Scope]", span_name: str, scope, wall0: float
+    ) -> None:
+        """Mint (or continue) the distributed trace for a freshly
+        registered session: the ambient context — set by the bridge from a
+        frame suffix, or by an embedder around a gossip delivery — is the
+        causal parent; with none this engine is the trace root. The bound
+        context's span is recorded so every peer contributes at least one
+        span per proposal to the stitched timeline."""
+        parent = current_context()
+        ctx = parent.child() if parent is not None else TraceContext.generate()
+        record.trace = ctx
+        trace_store.record(
+            span_name,
+            ctx,
+            wall0,
+            time.time() - wall0,
+            parent=parent.span_id if parent is not None else None,
+            peer=self._trace_peer,
+            attrs={
+                "scope": str(scope),
+                "proposal_id": record.proposal.proposal_id,
+            },
+        )
 
     def _ensure_unique_pid(
         self, scope: Scope, proposal: Proposal, taken: set[int] | None = None
@@ -775,6 +820,7 @@ class TpuConsensusEngine(Generic[Scope]):
         """
         if (scope, proposal.proposal_id) in self._index:
             raise ProposalAlreadyExist()
+        wall0 = time.time()
         config = self._resolve_config(scope, config, proposal)
         # The scalar oracle replays embedded votes with exact reference
         # semantics (chain validation, per-vote ECDSA, round caps); the dense
@@ -793,6 +839,18 @@ class TpuConsensusEngine(Generic[Scope]):
                 ),
             )
         self._register_session(scope, session, now)
+        if trace_store.enabled:
+            slot = self._index.get((scope, proposal.proposal_id))
+            if slot is not None:
+                # Continues the trace the proposal travelled with (ambient
+                # context from the bridge frame / gossip envelope); roots a
+                # fresh one for untraced senders.
+                self._bind_trace(
+                    self._records[slot],
+                    "consensus.process_proposal",
+                    scope,
+                    wall0,
+                )
 
     def ingest_proposals(
         self,
@@ -1161,6 +1219,13 @@ class TpuConsensusEngine(Generic[Scope]):
                 if code == int(StatusCode.OK):
                     host_accepted += 1
                     self._timelines.voted(slot, now, wall)
+                    if trace_store.enabled and record.trace is not None:
+                        trace_store.instant(
+                            "consensus.vote_applied",
+                            record.trace,
+                            peer=self._trace_peer,
+                            attrs={"owner": vote.vote_owner.hex()[:12]},
+                        )
                 if was_active and not record.session.state.is_active:
                     host_transitions += 1
                     # Host-spilled sessions are replicated on every
@@ -1168,13 +1233,17 @@ class TpuConsensusEngine(Generic[Scope]):
                     # events so a fleet-wide sum counts each decision once.
                     owned = self._owns_slot(slot)
                     host_owned_transitions += owned
+                    outcome = _OUTCOME_OF_STATE[state_code_of(record.session.state)]
                     self._timelines.decided(
-                        slot,
-                        _OUTCOME_OF_STATE[state_code_of(record.session.state)],
-                        now,
-                        wall,
-                        observe=owned,
+                        slot, outcome, now, wall, observe=owned,
                     )
+                    if trace_store.enabled and record.trace is not None:
+                        trace_store.instant(
+                            "consensus.decided",
+                            record.trace,
+                            peer=self._trace_peer,
+                            attrs={"outcome": outcome},
+                        )
                 if event is not None and self._owns_slot(slot):
                     host_events.append((i, scope, event))
                 continue
@@ -1227,6 +1296,15 @@ class TpuConsensusEngine(Generic[Scope]):
             outcome = _OUTCOME_OF_STATE.get(new_state)
             if outcome is not None:
                 self._timelines.decided(slot, outcome, now, wall)
+                if trace_store.enabled:
+                    tctx = self._records[slot].trace
+                    if tctx is not None:
+                        trace_store.instant(
+                            "consensus.decided",
+                            tctx,
+                            peer=self._trace_peer,
+                            attrs={"outcome": outcome},
+                        )
 
         # Host bookkeeping for accepted votes, in arrival order; remember the
         # last accepted vote per slot — that is the vote that flipped a slot
@@ -1244,6 +1322,15 @@ class TpuConsensusEngine(Generic[Scope]):
                 last_ok[int(slots[j])] = j
         for slot in last_ok:
             self._timelines.voted(slot, now, wall)
+            if trace_store.enabled:
+                tctx = self._records[slot].trace
+                if tctx is not None:
+                    trace_store.instant(
+                        "consensus.vote_applied",
+                        tctx,
+                        peer=self._trace_peer,
+                        attrs={"batch": int(batch)},
+                    )
 
         # Event emission in per-vote arrival order, mirroring the scalar
         # path exactly: the deciding vote emits ConsensusReached, and every
@@ -2114,6 +2201,13 @@ class TpuConsensusEngine(Generic[Scope]):
                 slot, outcome, now, time.monotonic(), by_timeout=True,
                 observe=owned,
             )
+            if trace_store.enabled and was_active and record.trace is not None:
+                trace_store.instant(
+                    "consensus.timeout_decided",
+                    record.trace,
+                    peer=self._trace_peer,
+                    attrs={"outcome": outcome},
+                )
         if new_state in (STATE_REACHED_YES, STATE_REACHED_NO):
             result = new_state == STATE_REACHED_YES
             if owned:
@@ -2187,6 +2281,15 @@ class TpuConsensusEngine(Generic[Scope]):
                 self._timelines.decided(
                     slot, outcome, now, wall, by_timeout=True, observe=owned
                 )
+                if trace_store.enabled:
+                    tctx = self._records[slot].trace
+                    if tctx is not None:
+                        trace_store.instant(
+                            "consensus.timeout_decided",
+                            tctx,
+                            peer=self._trace_peer,
+                            attrs={"outcome": outcome},
+                        )
             if not owned:
                 continue
             record = self._records[slot]
@@ -2321,6 +2424,129 @@ class TpuConsensusEngine(Generic[Scope]):
                 return tl.as_dict()
         tl = self._timelines.find(scope, proposal_id)
         return tl.as_dict() if tl is not None else None
+
+    def trace_context_of(self, scope: Scope, proposal_id: int):
+        """The distributed :class:`~hashgraph_tpu.obs.trace.TraceContext`
+        bound to a live session (None when untracked/untraced). The bridge
+        serializes this onto CREATE_PROPOSAL / CAST_VOTE responses so
+        embedders can carry it to the peers they gossip to."""
+        slot = self._index.get((scope, proposal_id))
+        if slot is None:
+            return None
+        return self._records[slot].trace
+
+    def explain_decision(self, scope: Scope, proposal_id: int) -> dict:
+        """Decision provenance: one JSON-ready verdict answering *why and
+        how* this proposal is in its current state.
+
+        Reconstructs the accepted vote chain (chain order, per-peer
+        contributions — columnar tallies included), the quorum arithmetic
+        (``div_ceil(2n, 3)`` exact path / ``ceil(n·t)`` general path /
+        n≤2 unanimity, with the observed yes/no/silent counts and an
+        independent re-run of the decision kernel as a cross-check), the
+        lifecycle timeline phases, and the bound distributed-trace
+        identity. Raises SessionNotFound for unknown proposals; a
+        :class:`~hashgraph_tpu.wal.DurableEngine` overlays the WAL LSN
+        watermark. Exposed over the bridge as ``OP_EXPLAIN``
+        (``BridgeClient.explain``)."""
+        record = self._get_record(scope, proposal_id)
+        session = self.export_session(scope, proposal_id)
+        proposal = session.proposal
+        n = proposal.expected_voters_count
+        thr = session.config.consensus_threshold
+        state = self._state_code(record)
+        status = {
+            STATE_ACTIVE: "active",
+            STATE_FAILED: "failed",
+            STATE_REACHED_YES: "reached",
+            STATE_REACHED_NO: "reached",
+        }[state]
+        result = (
+            state == STATE_REACHED_YES
+            if state in (STATE_REACHED_YES, STATE_REACHED_NO)
+            else None
+        )
+        timeline = self.proposal_timeline(scope, proposal_id)
+        by_timeout = bool(timeline and timeline.get("by_timeout"))
+        yes, total = session.tally_counts()
+        if n <= 2:
+            # Unanimity rule (reference: src/utils.rs:239-244).
+            rule = "unanimity (n <= 2)"
+            required = choice_required = n
+        else:
+            required = calculate_required_votes(n, thr)
+            choice_required = calculate_threshold_based_value(n, thr)
+            # EXACTLY the comparison calculate_threshold_based_value
+            # makes, so the stated rule always names the path that
+            # produced the numbers beside it.
+            rule = (
+                "div_ceil(2n, 3)"
+                if abs(thr - _TWO_THIRDS) < _F64_EPSILON
+                else f"ceil(n * {thr!r})"
+            )
+        # Independent re-run of the decision kernel over the reconstructed
+        # session (the same decide_now the scalar substrate runs, so the
+        # cross-check can never drift from the real semantics): must agree
+        # with the recorded outcome for vote-decided sessions (None for
+        # still-active / failed ones).
+        recomputed = session.decide_now(by_timeout)
+        chain = [
+            {
+                "position": i,
+                "owner": v.vote_owner.hex(),
+                "vote": v.vote,
+                "vote_id": v.vote_id,
+                "timestamp": v.timestamp,
+                "parent_hash": v.parent_hash.hex(),
+                "vote_hash": v.vote_hash.hex(),
+            }
+            for i, v in enumerate(proposal.votes)
+        ]
+        contributions = {
+            v.vote_owner.hex(): {"vote": v.vote, "via": "vote"}
+            for v in session.votes.values()
+        }
+        for owner, value in session.tallies.items():
+            contributions[owner.hex()] = {"vote": value, "via": "tally"}
+        trace = None
+        if record.trace is not None:
+            trace = {
+                "traceparent": record.trace.to_traceparent(),
+                "trace_id": record.trace.trace_id.hex(),
+                "span_id": record.trace.span_id.hex(),
+            }
+        return {
+            "scope": str(scope),
+            "proposal_id": proposal.proposal_id,
+            "status": status,
+            "result": result,
+            "by_timeout": by_timeout,
+            "proposal": {
+                "name": proposal.name,
+                "owner": proposal.proposal_owner.hex(),
+                "round": proposal.round,
+                "created_at": record.created_at,
+                "expiration_timestamp": proposal.expiration_timestamp,
+                "liveness_criteria_yes": proposal.liveness_criteria_yes,
+            },
+            "quorum": {
+                "expected_voters": n,
+                "threshold": thr,
+                "rule": rule,
+                "required_votes": required,
+                "required_choice_votes": choice_required,
+                "yes": yes,
+                "no": total - yes,
+                "total": total,
+                "silent": max(n - total, 0),
+                "reached": status == "reached",
+                "recomputed_result": recomputed,
+            },
+            "vote_chain": chain,
+            "contributions": contributions,
+            "timeline": timeline,
+            "trace": trace,
+        }
 
     def export_session(self, scope: Scope, proposal_id: int) -> ConsensusSession:
         """Materialise a scalar ConsensusSession from the pooled state —
@@ -2723,6 +2949,8 @@ for _name in (
     "get_reached_proposals",
     "get_scope_stats",
     "proposal_timeline",
+    "trace_context_of",
+    "explain_decision",
     "set_replay_mode",
     "export_session",
     "save_to_storage",

@@ -13,7 +13,14 @@ Four pieces, layered on (not replacing) the opt-in tracer in
   ``GET_METRICS`` opcode;
 - the always-on :class:`FlightRecorder` (``flight_recorder`` is the
   process-wide ring), auto-dumped as JSONL on engine faults and bridge
-  dispatch exceptions.
+  dispatch exceptions;
+- distributed causal tracing (:mod:`.trace`): traceparent-style
+  :class:`TraceContext` carried on bridge frames and gossip bytes, the
+  bounded process-wide :data:`trace_store` of context-tagged spans
+  (:func:`observed_span` feeds it whenever a context is active), Chrome
+  trace-event / Perfetto export, and :func:`merge_traces` stitching N
+  peers' dumps into one causal timeline. Decision provenance on top:
+  ``TpuConsensusEngine.explain_decision`` and the bridge ``OP_EXPLAIN``.
 
 Well-known families (all on the default registry):
 
@@ -43,6 +50,7 @@ wal_checkpoints_total                           counter    DurableEngine checkpo
 from __future__ import annotations
 
 import contextlib
+import functools
 import time
 
 from .flight import FlightRecorder, flight_recorder
@@ -54,10 +62,22 @@ from .registry import (
     Gauge,
     GaugeHandle,
     Histogram,
+    Info,
     MetricsRegistry,
     log_buckets,
 )
 from .timeline import ProposalTimeline, TimelineStore
+from .trace import (
+    TraceContext,
+    TraceSpan,
+    TraceStore,
+    attach_trace,
+    current_context,
+    extract_trace,
+    merge_traces,
+    trace_store,
+    use_context,
+)
 
 # ── Well-known family names ────────────────────────────────────────────
 
@@ -83,6 +103,7 @@ BRIDGE_REQUESTS_TOTAL = "bridge_requests_total"
 BRIDGE_ERRORS_TOTAL = "bridge_errors_total"
 FLIGHT_DUMPS_TOTAL = "flight_dumps_total"
 WAL_CHECKPOINTS_TOTAL = "wal_checkpoints_total"
+BUILD_INFO = "hashgraph_build_info"
 
 # Process-wide default registry (mirrors tracing.tracer's role).
 registry = MetricsRegistry()
@@ -120,6 +141,62 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         WAL_CHECKPOINTS_TOTAL,
     ):
         reg.counter(name)
+    reg.info(BUILD_INFO).set(
+        # Resolved at scrape time: the package version needs the top-level
+        # package object (circular at obs import time), and naming the JAX
+        # runtime backend must not be the thing that initializes it (obs —
+        # and the WAL, which imports obs — stays jax-free).
+        version=_pkg_version,
+        jax=lambda: _dist_version("jax"),
+        backend=_jax_backend,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _dist_version(dist: str) -> str:
+    """Installed version of ``dist`` WITHOUT importing it
+    (importlib.metadata reads dist-info only). Cached: the value cannot
+    change within a process, and every scrape resolves the labels —
+    Prometheus polling must not pay repeated sys.path metadata walks."""
+    try:
+        from importlib.metadata import version
+
+        return version(dist)
+    except Exception:
+        return "unknown"
+
+
+@functools.lru_cache(maxsize=None)
+def _pkg_version() -> str:
+    try:
+        from importlib.metadata import version
+
+        return version("hashgraph-tpu")
+    except Exception:
+        import sys
+
+        pkg = sys.modules.get("hashgraph_tpu")
+        return getattr(pkg, "__version__", "unknown") if pkg else "unknown"
+
+
+def _jax_backend() -> str:
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return "not-loaded"
+    try:
+        # Only NAME an already-initialized backend: default_backend()
+        # would otherwise initialize the platform client on the scrape
+        # thread (grabbing device memory, pinning the platform before a
+        # later distributed/platform-config call).
+        from jax._src import xla_bridge
+
+        if not xla_bridge._backends:
+            return "uninitialized"
+        return jax.default_backend()
+    except Exception:
+        return "uninitialized"
 
 
 _install_well_known(registry)
@@ -128,10 +205,15 @@ flight_recorder.dump_counter = registry.counter(FLIGHT_DUMPS_TOTAL)
 
 @contextlib.contextmanager
 def observed_span(tracer, name: str, histogram: Histogram, **attrs):
-    """Time a block into BOTH observability layers: always observe the
-    duration into ``histogram`` (registry, always on), and record a tracer
-    span when tracing is enabled. One perf_counter pair when tracing is
-    off — cheap enough for per-batch sites, which is where this is used."""
+    """Time a block into the observability layers: always observe the
+    duration into ``histogram`` (registry, always on); record a tracer
+    span when tracing is enabled; and when a distributed trace context is
+    active (:func:`hashgraph_tpu.obs.trace.use_context`), record a
+    context-tagged child span into :data:`trace_store` — this is how
+    engine/bridge/WAL spans join a cross-peer causal trace without any
+    per-site wiring. One perf_counter pair (plus one contextvar read)
+    when nothing is listening — cheap enough for per-batch sites, which
+    is where this is used."""
     start = time.perf_counter()
     try:
         yield
@@ -140,6 +222,17 @@ def observed_span(tracer, name: str, histogram: Histogram, **attrs):
         histogram.observe(duration)
         if tracer.enabled:
             tracer.record_span(name, start, duration, attrs)
+        ctx = current_context()
+        if ctx is not None and trace_store.enabled:
+            end = time.time()
+            trace_store.record(
+                name,
+                ctx.child(),
+                end - duration,
+                duration,
+                parent=ctx.span_id,
+                attrs=attrs,
+            )
 
 
 __all__ = [
@@ -148,12 +241,22 @@ __all__ = [
     "Gauge",
     "GaugeHandle",
     "Histogram",
+    "Info",
     "MetricsRegistry",
     "MetricsSidecar",
     "ProposalTimeline",
     "TimelineStore",
+    "TraceContext",
+    "TraceSpan",
+    "TraceStore",
+    "attach_trace",
+    "current_context",
+    "extract_trace",
     "flight_recorder",
     "log_buckets",
+    "merge_traces",
     "observed_span",
     "registry",
+    "trace_store",
+    "use_context",
 ]

@@ -39,12 +39,25 @@ def _fmt(value: float) -> str:
     return repr(float(value))
 
 
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def render(registry) -> str:
     lines: list[str] = []
     with registry._lock:
         counters = sorted(registry._counters.values(), key=lambda c: c.name)
         gauges = sorted(registry._gauges.values(), key=lambda g: g.name)
         histograms = sorted(registry._histograms.values(), key=lambda h: h.name)
+        infos = sorted(registry._infos.values(), key=lambda i: i.name)
+    for i in infos:
+        name = sanitize(i.name)
+        labels = ",".join(
+            f'{sanitize(k)}="{_escape_label(v)}"'
+            for k, v in sorted(i.labels().items())
+        )
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{{{labels}}} 1")
     for c in counters:
         name = sanitize(c.name)
         lines.append(f"# TYPE {name} counter")

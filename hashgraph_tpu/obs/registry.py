@@ -115,6 +115,40 @@ class Gauge:
         return total
 
 
+class Info:
+    """Constant metadata family rendered as a labelled gauge with value 1
+    (the Prometheus ``*_build_info`` convention). Label values may be
+    strings or zero-arg callables — callables resolve at read time, so a
+    label like the JAX runtime backend can be named lazily without the
+    metrics layer forcing the runtime up."""
+
+    __slots__ = ("name", "_labels", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._labels: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def set(self, **labels) -> None:
+        with self._lock:
+            self._labels.update(labels)
+
+    def labels(self) -> dict[str, str]:
+        """Resolved label set (callables invoked; a raising provider
+        yields ``"error"`` rather than poisoning the scrape)."""
+        with self._lock:
+            items = list(self._labels.items())
+        out: dict[str, str] = {}
+        for key, value in items:
+            if callable(value):
+                try:
+                    value = value()
+                except Exception:
+                    value = "error"
+            out[key] = str(value)
+        return out
+
+
 class GaugeHandle:
     """Unregistration token for one gauge provider (components with an
     explicit close(), e.g. WalWriter, unregister there instead of waiting
@@ -245,6 +279,7 @@ class MetricsRegistry:
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
+        self._infos: dict[str, Info] = {}
 
     # ── Family access ──────────────────────────────────────────────────
 
@@ -284,6 +319,13 @@ class MetricsRegistry:
             )
         return h
 
+    def info(self, name: str) -> Info:
+        i = self._infos.get(name)
+        if i is None:
+            with self._lock:
+                i = self._infos.setdefault(name, Info(name))
+        return i
+
     def register_gauge(self, name: str, fn, owner=None) -> GaugeHandle:
         """Attach a sampled-at-read provider to ``name`` (see
         :meth:`Gauge.add_provider`)."""
@@ -299,10 +341,12 @@ class MetricsRegistry:
             counters = list(self._counters.values())
             gauges = list(self._gauges.values())
             histograms = list(self._histograms.values())
+            infos = list(self._infos.values())
         return {
             "counters": {c.name: c.value for c in counters},
             "gauges": {g.name: g.value for g in gauges},
             "histograms": {h.name: h.snapshot() for h in histograms},
+            "infos": {i.name: i.labels() for i in infos},
         }
 
     def render_prometheus(self) -> str:
@@ -317,3 +361,4 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+            self._infos.clear()

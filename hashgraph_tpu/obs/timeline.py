@@ -75,19 +75,37 @@ class TimelineStore:
     def __init__(self, decision_histogram, completed_capacity: int = 1024):
         self._hist = decision_histogram
         self._live: dict[int, ProposalTimeline] = {}
-        self._done: deque[ProposalTimeline] = deque(maxlen=completed_capacity)
+        self._done: deque[ProposalTimeline] = deque()
+        self._done_capacity = completed_capacity
+        # (scope, proposal_id) -> most recent finished timeline: keeps
+        # bridge/explain lookups O(1) under churn instead of scanning the
+        # ring. Overwritten on pid reuse (most recent wins, matching the
+        # old reverse scan); an entry dies when ITS timeline ages out of
+        # the ring.
+        self._done_index: dict[tuple, ProposalTimeline] = {}
         # WAL recovery replays pre-crash traffic through the live ingest
         # paths; with this flag set every decision is stamped pre_decided
         # (outcome recorded, no latency derived or observed) — replay
         # speed is not decision latency.
         self.replay_mode = False
 
+    def _retire(self, tl: ProposalTimeline) -> None:
+        """Move a finished timeline into the bounded ring + (scope, pid)
+        index, evicting (and de-indexing) the oldest past capacity."""
+        self._done.append(tl)
+        self._done_index[(tl.scope, tl.proposal_id)] = tl
+        while len(self._done) > self._done_capacity:
+            old = self._done.popleft()
+            key = (old.scope, old.proposal_id)
+            if self._done_index.get(key) is old:
+                del self._done_index[key]
+
     def created(self, slot: int, scope, proposal_id: int, now: int, wall: float) -> None:
         # A recycled slot whose previous tenant was never forgotten (should
         # not happen — delete/evict forget) still must not leak: retire it.
         prev = self._live.get(slot)
         if prev is not None:
-            self._done.append(prev)
+            self._retire(prev)
         self._live[slot] = ProposalTimeline(scope, proposal_id, now, wall)
 
     def voted(self, slot: int, now: int, wall: float) -> None:
@@ -133,18 +151,16 @@ class TimelineStore:
     def forget(self, slot: int) -> None:
         tl = self._live.pop(slot, None)
         if tl is not None:
-            self._done.append(tl)
+            self._retire(tl)
 
     def get(self, slot: int) -> ProposalTimeline | None:
         return self._live.get(slot)
 
     def find(self, scope, proposal_id: int) -> ProposalTimeline | None:
         """Most recent finished timeline for (scope, proposal_id) — the
-        fallback when the session's slot is already recycled."""
-        for tl in reversed(self._done):
-            if tl.proposal_id == proposal_id and tl.scope == scope:
-                return tl
-        return None
+        fallback when the session's slot is already recycled. O(1) via the
+        retire-time index (bridge-side lookups stay flat under churn)."""
+        return self._done_index.get((scope, proposal_id))
 
     def live_count(self) -> int:
         return len(self._live)

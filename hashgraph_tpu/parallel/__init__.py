@@ -9,6 +9,7 @@ axis. Collectives (psum over ICI) appear only in global aggregation.
 from .mesh import PROPOSAL_AXIS, consensus_mesh
 from .multihost import (
     MultiHostPool,
+    agree_trace_context,
     distributed_consensus_mesh,
     initialize_distributed,
     local_slot_range,
@@ -20,6 +21,7 @@ __all__ = [
     "ShardedPool",
     "MultiHostPool",
     "PROPOSAL_AXIS",
+    "agree_trace_context",
     "initialize_distributed",
     "distributed_consensus_mesh",
     "local_slot_range",

@@ -48,6 +48,7 @@ __all__ = [
     "initialize_distributed",
     "distributed_consensus_mesh",
     "local_slot_range",
+    "agree_trace_context",
     "MultiHostPool",
 ]
 
@@ -72,6 +73,41 @@ def initialize_distributed(
 def distributed_consensus_mesh(axis_name: str = PROPOSAL_AXIS):
     """The 1-D consensus mesh spanning every device of every process."""
     return consensus_mesh(axis_name=axis_name)
+
+
+def agree_trace_context(ctx=None):
+    """Fleet-wide distributed-trace agreement: every process adopts
+    process 0's trace context so the replicated control plane's spans
+    (allocation, timeout sweeps) stitch into ONE causal trace instead of
+    N disjoint ones.
+
+    Collective — call with identical cadence on every process (like the
+    pool's control-plane ops), typically right after minting a root
+    context on process 0::
+
+        ctx = agree_trace_context(TraceContext.generate())
+        with use_context(ctx):
+            engine.sweep_timeouts(now)   # spans share one trace_id fleet-wide
+
+    ``ctx`` defaults to this process's ambient context
+    (:func:`~hashgraph_tpu.obs.trace.current_context`); processes other
+    than 0 may pass anything (or nothing) — process 0's value wins.
+    Returns the agreed context, or None when process 0 had none.
+    """
+    from ..obs.trace import TRACE_WIRE_BYTES, TraceContext, current_context
+
+    local = ctx if ctx is not None else current_context()
+    wire = np.frombuffer(
+        local.to_wire() if local is not None else bytes(TRACE_WIRE_BYTES),
+        np.uint8,
+    )
+    gathered = np.asarray(multihost_utils.process_allgather(wire)).reshape(
+        -1, TRACE_WIRE_BYTES
+    )
+    agreed = gathered[0].tobytes()
+    if not any(agreed):
+        return None
+    return TraceContext.from_wire(agreed)
 
 
 def local_slot_range(

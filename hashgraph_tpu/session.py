@@ -352,13 +352,21 @@ class ConsensusSession:
         else:
             self.proposal.round = min(self.proposal.round + vote_count, _U32_MAX)
 
-    def decide_now(self, is_timeout: bool) -> bool | None:
-        """Run the decision kernel over votes + columnar tallies (the
-        combined participant set — each owner appears in exactly one)."""
+    def tally_counts(self) -> tuple[int, int]:
+        """(yes, total) over the combined participant set — votes plus
+        columnar tallies, each owner in exactly one. The single source of
+        the counts both :meth:`decide_now` and the engine's
+        ``explain_decision`` report, so the provenance readout can never
+        drift from the kernel input."""
         yes = sum(1 for v in self.votes.values() if v.vote) + sum(
             1 for t in self.tallies.values() if t
         )
-        total = len(self.votes) + len(self.tallies)
+        return yes, len(self.votes) + len(self.tallies)
+
+    def decide_now(self, is_timeout: bool) -> bool | None:
+        """Run the decision kernel over votes + columnar tallies (the
+        combined participant set — each owner appears in exactly one)."""
+        yes, total = self.tally_counts()
         return decide(
             yes,
             total,

@@ -22,7 +22,11 @@ For the always-on production layer — Prometheus-style metrics families,
 decision-latency histograms, scrape endpoints, and the flight recorder —
 see :mod:`hashgraph_tpu.obs`; it layers on this tracer
 (:func:`~hashgraph_tpu.obs.observed_span` feeds both) rather than
-replacing it.
+replacing it. For *distributed* tracing — trace context on the wire,
+cross-peer span stitching into one Perfetto timeline, and the
+``explain_decision`` provenance readout — see
+:mod:`hashgraph_tpu.obs.trace`; ``observed_span`` tags its spans with
+the active :class:`~hashgraph_tpu.obs.trace.TraceContext` automatically.
 
 Well-known counter families (all emitted through the process-wide default
 tracer unless a component was given its own):
@@ -59,6 +63,29 @@ from dataclasses import dataclass, field
 # with concurrent file creation elsewhere (WAL segments, flight dumps).
 _UMASK = os.umask(0)
 os.umask(_UMASK)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Crash-safe text export: write to an mkstemp temp file in the
+    destination directory, widen the 0600 temp mode back to what a plain
+    open() would create (so log shippers under another uid keep access),
+    and ``os.replace`` into place — ``path`` either holds its previous
+    content or the complete new text, never a torn file. Shared by
+    :meth:`Tracer.export_jsonl` and the distributed-tracing exports
+    (:mod:`hashgraph_tpu.obs.trace`)."""
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".", dir=directory)
+    try:
+        os.chmod(tmp, 0o666 & ~_UMASK)
+        with os.fdopen(fd, "w") as fh:
+            fh.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 @dataclass
@@ -168,52 +195,31 @@ class Tracer:
         }
 
     def export_jsonl(self, path: str) -> None:
-        """Write counters, spans, and events as JSON lines.
-
-        Atomic: the lines are written to a temp file in the destination
-        directory and ``os.replace``d into place, so a crash (or a
-        serialization error) mid-export can never leave a torn trace file
-        — ``path`` either holds its previous content or the complete new
-        export."""
-        directory = os.path.dirname(os.path.abspath(path))
+        """Write counters, spans, and events as JSON lines, atomically
+        (see :func:`atomic_write_text`): a crash or serialization error
+        mid-export can never leave a torn trace file."""
         with self._lock:
-            fd, tmp = tempfile.mkstemp(
-                prefix=os.path.basename(path) + ".", dir=directory
+            lines = [
+                json.dumps(
+                    {"type": "counters", "values": dict(self._counters)}
+                )
+            ]
+            lines.extend(
+                json.dumps(
+                    {
+                        "type": "span",
+                        "name": s.name,
+                        "start": s.start,
+                        "duration": s.duration,
+                        **s.attrs,
+                    }
+                )
+                for s in self._spans
             )
-            try:
-                # mkstemp creates 0600; restore the umask-derived mode a
-                # plain open() would have given, so downstream readers
-                # (log shippers under another uid) keep their access.
-                os.chmod(tmp, 0o666 & ~_UMASK)
-                with os.fdopen(fd, "w") as fh:
-                    fh.write(
-                        json.dumps(
-                            {"type": "counters", "values": dict(self._counters)}
-                        )
-                        + "\n"
-                    )
-                    for s in self._spans:
-                        fh.write(
-                            json.dumps(
-                                {
-                                    "type": "span",
-                                    "name": s.name,
-                                    "start": s.start,
-                                    "duration": s.duration,
-                                    **s.attrs,
-                                }
-                            )
-                            + "\n"
-                        )
-                    for e in self._events:
-                        fh.write(json.dumps({"type": "event", **e}) + "\n")
-                os.replace(tmp, path)
-            except BaseException:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                raise
+            lines.extend(
+                json.dumps({"type": "event", **e}) for e in self._events
+            )
+            atomic_write_text(path, "".join(line + "\n" for line in lines))
 
 
 # Process-wide default tracer; engine instances use this unless given one.

@@ -187,6 +187,20 @@ class DurableEngine:
         # Reads and anything else not intercepted delegate to the engine.
         return getattr(self._engine, name)
 
+    def explain_decision(self, scope, proposal_id) -> dict:
+        """Engine decision provenance plus this peer's durability
+        position: the WAL LSN watermark at readout time (every record at
+        or below ``last_lsn`` survives a crash under the configured fsync
+        policy) and the last checkpoint watermark (records at or below it
+        are also covered by a snapshot)."""
+        out = self._engine.explain_decision(scope, proposal_id)
+        out["wal"] = {
+            "last_lsn": self._wal.last_lsn,
+            "checkpoint_watermark": self._ckpt_watermark,
+            "fsync_policy": self._wal.fsync_policy,
+        }
+        return out
+
     # ── Recovery ───────────────────────────────────────────────────────
 
     def recover(self, storage=None, *, after_lsn: "int | None" = None) -> ReplayStats:

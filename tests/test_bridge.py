@@ -167,6 +167,116 @@ class TestPythonClient:
             assert cl.ping() == P.PROTOCOL_VERSION
 
 
+class TestTraceContextOnTheWire:
+    def test_create_response_carries_bound_context(self, client):
+        pid, _ = client.create_proposal(client.add_peer()[0], "tr1", NOW, "p", b"", 3, 600)
+        ctx = client.last_trace_context
+        assert ctx is not None
+        assert len(ctx.trace_id) == 16 and len(ctx.span_id) == 8
+
+    def test_context_propagates_across_peers(self, client):
+        alice, _ = client.add_peer()
+        bob, _ = client.add_peer()
+        pid, proposal = client.create_proposal(alice, "tr2", NOW, "p", b"", 3, 600)
+        ctx = client.last_trace_context
+        client.process_proposal(bob, "tr2", proposal, NOW + 1, trace=ctx)
+        vote = client.cast_vote(bob, "tr2", pid, True, NOW + 2)
+        bob_ctx = client.last_trace_context
+        # Same trace on both peers, different span identities.
+        assert bob_ctx.trace_id == ctx.trace_id
+        assert bob_ctx.span_id != ctx.span_id
+        client.process_vote(alice, "tr2", vote, NOW + 3, trace=ctx)
+
+    def test_old_wire_client_interoperates(self, server):
+        """A seed-protocol embedder: frames WITHOUT trace suffixes, and
+        response tails ignored. Must decode identically and decide."""
+        host, port = server.address
+        with socket.create_connection((host, port), timeout=10) as sock:
+            def call(opcode, payload):
+                sock.sendall(P.encode_frame(opcode, payload))
+                status, cursor = P.read_frame(sock)
+                assert status == P.STATUS_OK, status
+                return cursor
+
+            peer = call(P.OP_ADD_PEER, P.u8(0)).u32()
+            # CREATE_PROPOSAL exactly as the seed client encoded it.
+            cursor = call(
+                P.OP_CREATE_PROPOSAL,
+                P.u32(peer) + P.string("old") + P.u64(NOW) + P.string("p")
+                + P.blob(b"") + P.u32(1) + P.u64(600) + P.u8(1),
+            )
+            pid = cursor.u32()
+            cursor.blob()
+            assert not cursor.done()  # new server appended a suffix...
+            # ...which an old client simply never reads. Keep going:
+            call(
+                P.OP_CAST_VOTE,
+                P.u32(peer) + P.string("old") + P.u32(pid) + P.u8(1) + P.u64(NOW + 1),
+            )
+            result = call(
+                P.OP_GET_RESULT, P.u32(peer) + P.string("old") + P.u32(pid)
+            ).u8()
+            assert result == P.RESULT_YES
+
+    def test_short_or_unknown_suffix_tails_are_tolerated(self, server):
+        """Trailing bytes that are not a well-formed version-0 suffix —
+        short fragments, future versions — are consumed and ignored, the
+        same tolerance the pre-suffix server gave all trailing bytes."""
+        host, port = server.address
+        with socket.create_connection((host, port), timeout=10) as sock:
+            def call(opcode, payload):
+                sock.sendall(P.encode_frame(opcode, payload))
+                status, cursor = P.read_frame(sock)
+                return status, cursor
+
+            status, cursor = call(P.OP_ADD_PEER, P.u8(0))
+            assert status == P.STATUS_OK
+            peer = cursor.u32()
+            base = (
+                P.u32(peer) + P.string("tail") + P.u64(NOW) + P.string("p")
+                + P.blob(b"") + P.u32(3) + P.u64(600) + P.u8(1)
+            )
+            for tail in (b"\x07\x07\x07", P.u8(9) + b"z" * 25):
+                status, _ = call(P.OP_CREATE_PROPOSAL, base + tail)
+                assert status == P.STATUS_OK, (tail, status)
+
+    def test_suffixed_and_bare_frames_decode_identically(self, client):
+        """The same PROCESS_PROPOSAL bytes land the same session state
+        whether or not the optional suffix is present."""
+        alice, _ = client.add_peer()
+        peers = [client.add_peer()[0] for _ in range(2)]
+        pid, proposal = client.create_proposal(alice, "tr3", NOW, "p", b"", 3, 600)
+        ctx = client.last_trace_context
+        client.process_proposal(peers[0], "tr3", proposal, NOW + 1, trace=ctx)
+        client.process_proposal(peers[1], "tr3", proposal, NOW + 1)  # bare
+        assert client.get_stats(peers[0], "tr3") == client.get_stats(peers[1], "tr3")
+
+
+class TestExplainOpcode:
+    def test_explain_decided_proposal(self, client):
+        peers, pid = run_quickstart(client, "expl")
+        verdict = client.explain(peers[0], "expl", pid)
+        assert verdict["status"] == "reached" and verdict["result"] is True
+        quorum = verdict["quorum"]
+        assert quorum["expected_voters"] == 3
+        assert quorum["required_votes"] == 2  # div_ceil(2*3, 3)
+        assert quorum["rule"] == "div_ceil(2n, 3)"
+        # Quorum hits at 2 of 3 — the last vote arrives post-decision
+        # (ALREADY_REACHED) and is not part of the accepted chain.
+        assert quorum["yes"] >= quorum["required_votes"] and quorum["reached"]
+        assert quorum["recomputed_result"] is True
+        assert len(verdict["vote_chain"]) == quorum["total"]
+        assert len(verdict["contributions"]) == quorum["total"]
+        assert verdict["timeline"]["outcome"] == "yes"
+        assert verdict["trace"] is not None
+
+    def test_explain_unknown_session_maps_status(self, client):
+        peer, _ = client.add_peer()
+        with pytest.raises(BridgeError) as exc:
+            client.explain(peer, "expl", 987654)
+        assert exc.value.status == int(StatusCode.SESSION_NOT_FOUND)
+
+
 class TestConcurrentClients:
     def test_parallel_connections_share_peers_safely(self, server):
         """Many connections driving the same peer concurrently: the engine's

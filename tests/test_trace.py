@@ -1,0 +1,365 @@
+"""Distributed causal tracing: context propagation, span stitching,
+Perfetto export, and decision provenance (EXPLAIN).
+
+Covers the full tentpole surface in-process:
+
+- TraceContext wire/traceparent round-trips and child derivation;
+- the gossip-envelope field (attach/extract on protobuf bytes) and its
+  backward compatibility (decoders skip it, signatures unaffected);
+- the bounded TraceStore, observed_span tagging, JSONL/Chrome export,
+  and cross-peer stitching via merge_traces;
+- engine integration: contexts bound at create/process, spans from two
+  peers sharing one trace_id, explain_decision's quorum arithmetic;
+- the O(1) TimelineStore index semantics.
+"""
+
+import json
+import os
+
+import pytest
+
+from hashgraph_tpu.engine import TpuConsensusEngine
+from hashgraph_tpu.errors import SessionNotFound
+from hashgraph_tpu.obs.registry import MetricsRegistry
+from hashgraph_tpu.obs.timeline import TimelineStore
+from hashgraph_tpu.obs.trace import (
+    TraceContext,
+    TraceStore,
+    attach_trace,
+    current_context,
+    extract_trace,
+    load_spans_jsonl,
+    merge_traces,
+    trace_store,
+    use_context,
+)
+from hashgraph_tpu.signing.stub import StubConsensusSigner
+from hashgraph_tpu.types import CreateProposalRequest
+from hashgraph_tpu.wire import Proposal, Vote
+
+NOW = 1_700_000_000
+
+
+def fresh_engine(ident: bytes, **kwargs) -> TpuConsensusEngine:
+    kwargs.setdefault("capacity", 8)
+    kwargs.setdefault("voter_capacity", 8)
+    return TpuConsensusEngine(StubConsensusSigner(ident), **kwargs)
+
+
+def make_request(expected: int = 2, owner: bytes = b"o" * 20):
+    return CreateProposalRequest(
+        name="p",
+        payload=b"",
+        proposal_owner=owner,
+        expected_voters_count=expected,
+        expiration_timestamp=600,
+        liveness_criteria_yes=True,
+    )
+
+
+class TestTraceContext:
+    def test_wire_roundtrip(self):
+        ctx = TraceContext.generate()
+        assert TraceContext.from_wire(ctx.to_wire()) == ctx
+        assert len(ctx.to_wire()) == 25
+
+    def test_wire_rejects_bad_length(self):
+        with pytest.raises(ValueError):
+            TraceContext.from_wire(b"short")
+
+    def test_traceparent_roundtrip(self):
+        ctx = TraceContext.generate()
+        header = ctx.to_traceparent()
+        assert header.startswith("00-")
+        assert TraceContext.from_traceparent(header) == ctx
+
+    def test_traceparent_rejects_junk(self):
+        with pytest.raises(ValueError):
+            TraceContext.from_traceparent("01-aa-bb-cc")
+
+    def test_child_shares_trace_id(self):
+        ctx = TraceContext.generate()
+        kid = ctx.child()
+        assert kid.trace_id == ctx.trace_id
+        assert kid.span_id != ctx.span_id
+
+    def test_use_context_none_is_noop(self):
+        with use_context(None):
+            assert current_context() is None
+
+    def test_use_context_nests_and_restores(self):
+        a, b = TraceContext.generate(), TraceContext.generate()
+        with use_context(a):
+            assert current_context() == a
+            with use_context(b):
+                assert current_context() == b
+            assert current_context() == a
+        assert current_context() is None
+
+
+class TestEnvelopeField:
+    def test_attach_then_decode_is_identical(self):
+        vote = Vote(vote_id=7, vote_owner=b"abc", proposal_id=3, vote=True)
+        ctx = TraceContext.generate()
+        raw = attach_trace(vote.encode(), ctx)
+        assert Vote.decode(raw) == vote  # unknown field skipped
+        assert extract_trace(raw) == ctx
+
+    def test_attach_on_proposal(self):
+        proposal = Proposal(name="n", proposal_id=9, payload=b"pp")
+        ctx = TraceContext.generate()
+        raw = attach_trace(proposal.encode(), ctx)
+        assert Proposal.decode(raw) == proposal
+        assert extract_trace(raw) == ctx
+
+    def test_extract_absent_is_none(self):
+        assert extract_trace(Vote(vote_id=1).encode()) is None
+        assert extract_trace(b"") is None
+
+    def test_extract_never_raises_on_junk(self):
+        for junk in (b"\xff" * 40, b"\x93\x0f", os.urandom(64)):
+            extract_trace(junk)  # must not raise
+
+
+class TestTraceStore:
+    def test_bounded_rolling_window_with_drop_count(self):
+        store = TraceStore(capacity=2, peer="t")
+        ctx = TraceContext.generate()
+        for i in range(5):
+            store.record(f"s{i}", ctx.child(), 0.0, 0.1)
+        # Rolling window: the NEWEST spans survive (a long-running server
+        # can always capture an incident trace), evictions are counted.
+        assert [s.name for s in store.spans()] == ["s3", "s4"]
+        assert store.dropped == 3
+        store.clear()
+        assert store.spans() == [] and store.dropped == 0
+
+    def test_disabled_records_nothing(self):
+        store = TraceStore(peer="t")
+        store.enabled = False
+        store.record("s", TraceContext.generate(), 0.0, 0.1)
+        assert store.spans() == []
+
+    def test_peer_and_trace_filters(self):
+        store = TraceStore(peer="default")
+        a, b = TraceContext.generate(), TraceContext.generate()
+        store.record("x", a, 0.0, 0.1, peer="p1")
+        store.record("y", b, 0.0, 0.1, peer="p2")
+        assert [s.name for s in store.spans(peer="p1")] == ["x"]
+        assert [s.name for s in store.spans(trace_id=b.trace_id)] == ["y"]
+
+    def test_jsonl_roundtrip(self, tmp_path):
+        store = TraceStore(peer="t")
+        ctx = TraceContext.generate()
+        store.record(
+            "s", ctx, 1.5, 0.25, parent=b"\x01" * 8, attrs={"k": 1}
+        )
+        path = str(tmp_path / "spans.jsonl")
+        assert store.export_jsonl(path) == 1
+        [span] = load_spans_jsonl(path)
+        assert span.name == "s" and span.trace_id == ctx.trace_id
+        assert span.parent_id == b"\x01" * 8 and span.attrs == {"k": 1}
+        assert span.start == 1.5 and span.duration == 0.25
+
+    def test_chrome_export_shape(self, tmp_path):
+        store = TraceStore(peer="t")
+        ctx = TraceContext.generate()
+        store.record("s", ctx, 1.0, 0.5)
+        store.instant("i", ctx, ts=2.0)
+        path = str(tmp_path / "trace.json")
+        store.export_chrome(path)
+        with open(path) as fh:
+            doc = json.load(fh)
+        phases = {e["ph"] for e in doc["traceEvents"]}
+        assert {"M", "X", "i"} <= phases
+        x = next(e for e in doc["traceEvents"] if e["ph"] == "X")
+        assert x["ts"] == 1.0e6 and x["dur"] == 0.5e6
+        assert x["args"]["trace_id"] == ctx.trace_id.hex()
+
+    def test_merge_traces_stitches_and_orders(self, tmp_path):
+        ctx = TraceContext.generate()
+        a = TraceStore(peer="peer-a")
+        b = TraceStore(peer="peer-b")
+        a.record("create", ctx, 10.0, 0.5)
+        b.record("process", ctx.child(), 11.0, 0.5, parent=ctx.span_id)
+        b.instant("decided", ctx, ts=12.0)
+        a_path, b_path = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        a.export_jsonl(a_path)
+        b.export_jsonl(b_path)
+        out = str(tmp_path / "merged.json")
+        summary = merge_traces([a_path, b_path], out)
+        assert summary["spans"] == 3
+        assert summary["peers"] == ["peer-a", "peer-b"]
+        assert summary["traces"] == {ctx.trace_id.hex(): 3}
+        with open(out) as fh:
+            doc = json.load(fh)
+        names = [e["name"] for e in doc["traceEvents"] if e["ph"] != "M"]
+        assert names == ["create", "process", "decided"]  # causal order
+
+
+class TestObservedSpanTagging:
+    def test_tagged_only_under_context(self):
+        from hashgraph_tpu.obs import observed_span
+        from hashgraph_tpu.tracing import Tracer
+
+        reg = MetricsRegistry()
+        hist = reg.histogram("tagging_test_seconds")
+        tracer = Tracer()
+        before = len(trace_store.spans())
+        with observed_span(tracer, "untagged.span", hist):
+            pass
+        assert len(trace_store.spans()) == before  # no ambient context
+        ctx = TraceContext.generate()
+        with use_context(ctx):
+            with observed_span(tracer, "tagged.span", hist, votes=3):
+                pass
+        [span] = trace_store.spans(trace_id=ctx.trace_id)
+        assert span.name == "tagged.span"
+        assert span.parent_id == ctx.span_id
+        assert span.attrs == {"votes": 3}
+        assert hist.count == 2  # histogram observes either way
+
+
+class TestEngineTracing:
+    def two_peer_decided(self):
+        a = fresh_engine(b"a" * 20)
+        b = fresh_engine(b"b" * 20)
+        proposal = a.create_proposal("s", make_request(), NOW)
+        pid = proposal.proposal_id
+        ctx = a.trace_context_of("s", pid)
+        wire = attach_trace(proposal.encode(), ctx)
+        with use_context(extract_trace(wire)):
+            b.process_incoming_proposal("s", Proposal.decode(wire), NOW)
+        va = a.cast_vote("s", pid, True, NOW + 1)
+        vb = b.cast_vote("s", pid, True, NOW + 1)
+        a.process_incoming_vote("s", vb.clone(), NOW + 2)
+        b.process_incoming_vote("s", va.clone(), NOW + 2)
+        return a, b, pid, ctx
+
+    def test_cross_peer_spans_share_trace_id(self):
+        a, b, pid, ctx = self.two_peer_decided()
+        assert a.get_consensus_result("s", pid) is True
+        b_ctx = b.trace_context_of("s", pid)
+        assert b_ctx.trace_id == ctx.trace_id
+        assert b_ctx.span_id != ctx.span_id
+        spans = trace_store.spans(trace_id=ctx.trace_id)
+        peers = {s.peer for s in spans}
+        assert {"peer:" + (b"a" * 20).hex()[:12],
+                "peer:" + (b"b" * 20).hex()[:12]} <= peers
+        names = {s.name for s in spans}
+        assert {"consensus.create_proposal", "consensus.process_proposal",
+                "consensus.vote_applied", "consensus.decided"} <= names
+
+    def test_create_without_ambient_roots_a_trace(self):
+        engine = fresh_engine(os.urandom(20))
+        proposal = engine.create_proposal("s", make_request(), NOW)
+        ctx = engine.trace_context_of("s", proposal.proposal_id)
+        assert ctx is not None and len(ctx.trace_id) == 16
+
+    def test_create_under_ambient_joins_it(self):
+        engine = fresh_engine(os.urandom(20))
+        root = TraceContext.generate()
+        with use_context(root):
+            proposal = engine.create_proposal("s", make_request(), NOW)
+        ctx = engine.trace_context_of("s", proposal.proposal_id)
+        assert ctx.trace_id == root.trace_id
+
+    def test_trace_context_of_unknown_is_none(self):
+        engine = fresh_engine(os.urandom(20))
+        assert engine.trace_context_of("s", 12345) is None
+
+
+class TestExplainDecision:
+    def test_explain_reached(self):
+        a, b, pid, ctx = TestEngineTracing().two_peer_decided()
+        verdict = a.explain_decision("s", pid)
+        assert verdict["status"] == "reached" and verdict["result"] is True
+        quorum = verdict["quorum"]
+        assert quorum["expected_voters"] == 2
+        assert quorum["rule"] == "unanimity (n <= 2)"
+        assert quorum["required_votes"] == 2
+        assert quorum["yes"] == 2 and quorum["no"] == 0
+        assert quorum["reached"] and quorum["recomputed_result"] is True
+        assert len(verdict["vote_chain"]) == 2
+        owners = {c["owner"] for c in verdict["vote_chain"]}
+        assert owners == {(b"a" * 20).hex(), (b"b" * 20).hex()}
+        assert verdict["contributions"][(b"b" * 20).hex()]["via"] == "vote"
+        assert verdict["timeline"]["outcome"] == "yes"
+        assert verdict["trace"]["trace_id"] == ctx.trace_id.hex()
+        json.dumps(verdict)  # JSON-safe end to end
+
+    def test_explain_quorum_arithmetic_ceil_2n3(self):
+        engine = fresh_engine(os.urandom(20))
+        proposal = engine.create_proposal("s", make_request(expected=7), NOW)
+        verdict = engine.explain_decision("s", proposal.proposal_id)
+        quorum = verdict["quorum"]
+        assert quorum["rule"] == "div_ceil(2n, 3)"
+        assert quorum["required_votes"] == (2 * 7 + 2) // 3 == 5
+        assert verdict["status"] == "active" and verdict["result"] is None
+        assert quorum["recomputed_result"] is None
+
+    def test_explain_timeout_failure(self):
+        from hashgraph_tpu.errors import InsufficientVotesAtTimeout
+
+        engine = fresh_engine(os.urandom(20))
+        # n=2 unanimity with zero votes: undecidable at timeout.
+        proposal = engine.create_proposal("s", make_request(expected=2), NOW)
+        with pytest.raises(InsufficientVotesAtTimeout):
+            engine.handle_consensus_timeout("s", proposal.proposal_id, NOW + 700)
+        verdict = engine.explain_decision("s", proposal.proposal_id)
+        assert verdict["status"] == "failed" and verdict["by_timeout"] is True
+        assert verdict["quorum"]["total"] == 0
+
+    def test_explain_unknown_raises(self):
+        engine = fresh_engine(os.urandom(20))
+        with pytest.raises(SessionNotFound):
+            engine.explain_decision("s", 424242)
+
+    def test_durable_engine_overlays_wal_watermark(self, tmp_path):
+        from hashgraph_tpu.wal import DurableEngine
+
+        durable = DurableEngine(
+            fresh_engine(os.urandom(20)), str(tmp_path / "wal")
+        )
+        with durable:
+            proposal = durable.create_proposal("s", make_request(), NOW)
+            verdict = durable.explain_decision("s", proposal.proposal_id)
+            assert verdict["wal"]["last_lsn"] >= 1
+            assert verdict["wal"]["checkpoint_watermark"] == 0
+            assert verdict["wal"]["fsync_policy"] in ("always", "batch", "off")
+
+
+class TestTimelineIndex:
+    def make(self):
+        reg = MetricsRegistry()
+        return TimelineStore(reg.histogram("idx_test_seconds"), completed_capacity=3)
+
+    def test_find_after_forget_is_o1_indexed(self):
+        store = self.make()
+        store.created(0, "s", 11, NOW, 1.0)
+        store.decided(0, "yes", NOW + 1, 2.0)
+        store.forget(0)
+        tl = store.find("s", 11)
+        assert tl is not None and tl.outcome == "yes"
+        assert store.find("s", 99) is None
+
+    def test_eviction_drops_index_entries(self):
+        store = self.make()
+        for i in range(5):
+            store.created(i, "s", 100 + i, NOW, 1.0)
+            store.forget(i)
+        # capacity 3: the two oldest aged out of ring AND index.
+        assert store.find("s", 100) is None
+        assert store.find("s", 101) is None
+        for pid in (102, 103, 104):
+            assert store.find("s", pid) is not None
+
+    def test_pid_reuse_finds_most_recent(self):
+        store = self.make()
+        store.created(0, "s", 7, NOW, 1.0)
+        store.decided(0, "no", NOW + 1, 2.0)
+        store.forget(0)
+        store.created(1, "s", 7, NOW + 2, 3.0)
+        store.decided(1, "yes", NOW + 3, 4.0)
+        store.forget(1)
+        assert store.find("s", 7).outcome == "yes"

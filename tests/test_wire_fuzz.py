@@ -65,3 +65,50 @@ def test_decoder_never_crashes_unexpectedly(junk):
             cls.decode(junk)
         except ValueError:
             pass
+
+
+# ── Trace-context backward compatibility ───────────────────────────────
+
+from hashgraph_tpu.obs.trace import (  # noqa: E402
+    TraceContext,
+    attach_trace,
+    extract_trace,
+)
+
+contexts = st.builds(
+    TraceContext,
+    trace_id=st.binary(min_size=16, max_size=16),
+    span_id=st.binary(min_size=8, max_size=8),
+    flags=st.integers(min_value=0, max_value=255),
+)
+
+
+@settings(max_examples=200, deadline=None)
+@given(vote=votes, ctx=contexts)
+def test_vote_with_attached_trace_decodes_identically(vote, ctx):
+    """The gossip trace field is invisible to decoders (old peers see the
+    exact same Vote) and recoverable by new peers."""
+    raw = attach_trace(vote.encode(), ctx)
+    assert Vote.decode(raw) == vote
+    assert extract_trace(raw) == ctx
+    # Re-encoding the decoded message drops the unknown field — the
+    # canonical form (and any signature over it) is unchanged.
+    assert Vote.decode(raw).encode() == vote.encode()
+
+
+@settings(max_examples=100, deadline=None)
+@given(proposal=proposals, ctx=contexts)
+def test_proposal_with_attached_trace_decodes_identically(proposal, ctx):
+    raw = attach_trace(proposal.encode(), ctx)
+    assert Proposal.decode(raw) == proposal
+    assert extract_trace(raw) == ctx
+
+
+@settings(max_examples=300, deadline=None)
+@given(junk=st.binary(max_size=120))
+def test_extract_trace_never_raises(junk):
+    """extract_trace consumes untrusted gossip bytes: absent/malformed
+    contexts yield None, never an exception."""
+    assert extract_trace(junk) is None or isinstance(
+        extract_trace(junk), TraceContext
+    )
