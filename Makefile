@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench metrics-smoke trace-smoke smoke
+.PHONY: verify tier1 dev-install test bench bench-redelivery metrics-smoke trace-smoke smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -25,6 +25,13 @@ test:
 
 bench:
 	python bench.py
+
+# Amortized-verification bench: gossip redelivery + incremental chain
+# growth, cache-on vs cache-off, real ECDSA (host-substrate sessions, so
+# it runs identically under JAX_PLATFORMS=cpu). The fast tier-1 smoke for
+# the same paths is tests/test_redelivery.py (stub signer).
+bench-redelivery:
+	python bench.py redelivery
 
 # End-to-end observability check: start a bridge server (WAL + HTTP
 # sidecar), drive a proposal to decision, scrape /metrics + /healthz and

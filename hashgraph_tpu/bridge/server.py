@@ -23,7 +23,7 @@ import threading
 import time
 from struct import error as struct_error
 
-from ..engine import TpuConsensusEngine
+from ..engine import TpuConsensusEngine, VerifiedVoteCache
 from ..errors import ConsensusError
 from ..events import BroadcastEventBus, EventReceiver
 from ..obs import (
@@ -90,6 +90,11 @@ class BridgeServer:
     server's lifetime; read the bound port from :attr:`metrics_address`.
     The ``GET_METRICS`` opcode serves the identical text over the bridge
     wire itself, sidecar or not.
+
+    ``verify_cache`` ("shared" default) gives every default-built peer
+    engine ONE :class:`~hashgraph_tpu.engine.VerifiedVoteCache`, so a vote
+    gossiped to N co-hosted peers is signature-verified once per process;
+    its hit/miss/evict counters land on the registry above.
     """
 
     def __init__(
@@ -104,12 +109,28 @@ class BridgeServer:
         wal_fsync: str = "batch",
         metrics_port: int | None = None,
         metrics_host: str = "127.0.0.1",
+        verify_cache: "VerifiedVoteCache | None | str" = "shared",
     ):
         self._host = host
         self._port = port
         self._capacity = capacity
         self._voter_capacity = voter_capacity
         self._engine_factory = engine_factory
+        # ONE admission cache for every peer engine this server builds
+        # ("shared", the default): co-hosted peers receive the same
+        # gossiped votes, so a vote is ECDSA-verified once per server
+        # process instead of once per peer. Pass an instance to share it
+        # wider (or size it), or None to disable caching. Engines from
+        # ``engine_factory`` manage their own cache.
+        if isinstance(verify_cache, str) and verify_cache != "shared":
+            # An unknown string would propagate into every peer engine and
+            # crash each one at its first ingest — reject it here.
+            raise ValueError(
+                'verify_cache must be "shared", a VerifiedVoteCache, or None'
+            )
+        self._verify_cache = (
+            VerifiedVoteCache() if verify_cache == "shared" else verify_cache
+        )
         # Durability: with a wal_dir every peer's engine is wrapped in a
         # DurableEngine logging each incoming wire message BEFORE its ack
         # frame is sent (the response is only written after the handler —
@@ -389,6 +410,7 @@ class BridgeServer:
             event_bus=BroadcastEventBus(),
             capacity=self._capacity,
             voter_capacity=self._voter_capacity,
+            verify_cache=self._verify_cache,
         )
 
     def _durable_engine(self, signer, identity: bytes):

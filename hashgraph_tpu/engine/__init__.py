@@ -9,6 +9,7 @@ reference's scalar API is a thin veneer over the batch path (:mod:`.engine`).
 from .engine import SessionRecord, TpuConsensusEngine
 from .pool import PoolFullError, ProposalPool, SlotMeta
 from .storage import TpuBackedStorage
+from .verify_cache import VerifiedVoteCache
 
 __all__ = [
     "TpuConsensusEngine",
@@ -17,4 +18,5 @@ __all__ = [
     "ProposalPool",
     "SlotMeta",
     "PoolFullError",
+    "VerifiedVoteCache",
 ]

@@ -42,6 +42,7 @@ from ..errors import (
 from ..events import BroadcastEventBus, ConsensusEventBus
 from ..obs import (
     CHAIN_KERNEL_SECONDS,
+    CHAIN_SUFFIX_LENGTH,
     DECISION_LATENCY,
     DECISIONS_TOTAL,
     DEFAULT_SIZE_BUCKETS,
@@ -71,12 +72,15 @@ from ..ops.decide import (
 from ..protocol import (
     _F64_EPSILON,
     _TWO_THIRDS,
+    COMPUTE_CHAIN,
     build_vote,
     calculate_required_votes,
     calculate_threshold_based_value,
+    compute_vote_hash,
     regenerate_until_unique,
     validate_proposal_timestamp,
     validate_vote,
+    validate_vote_chain,
 )
 from ..scope_config import ScopeConfig, ScopeConfigBuilder
 from ..service import DEFAULT_MAX_SESSIONS_PER_SCOPE, ConsensusStats
@@ -92,10 +96,20 @@ from ..types import (
 from ..wire import Proposal, Vote, normalize_wire_votes
 from .pool import ProposalPool
 from .session_sync import allocate_slot, load_session_rows, state_code_of
+from .verify_cache import MISS, VerifiedVoteCache
 
 Scope = TypeVar("Scope", bound=Hashable)
 
 _U32_MAX = 0xFFFFFFFF
+
+
+def hashlib_sha256_8(data: bytes) -> bytes:
+    """First 8 bytes of SHA-256 — the admission-cache scheme tag (a
+    stable, collision-negligible namespace for a handful of scheme
+    types; full digests would fatten every cache key for nothing)."""
+    import hashlib
+
+    return hashlib.sha256(data).digest()[:8]
 
 
 def _canonical_scope_bytes(scope) -> bytes:
@@ -247,8 +261,32 @@ class TpuConsensusEngine(Generic[Scope]):
         voter_capacity: int | None = None,
         max_sessions_per_scope: int = DEFAULT_MAX_SESSIONS_PER_SCOPE,
         pool: ProposalPool | None = None,
+        verify_cache: "VerifiedVoteCache | None | str" = "default",
     ):
         self._signer = signer
+        # Memoized vote-admission verdicts (verify each unique vote once —
+        # the redelivery/incremental-chain amortization, see verify_cache
+        # module docstring). "default" builds a per-engine cache; pass a
+        # shared instance to pool verdicts across engines (BridgeServer
+        # does, one cache per server process), or None to disable —
+        # disabled restores the pre-cache verification flow byte for byte.
+        if isinstance(verify_cache, str) and verify_cache != "default":
+            # Any other string (e.g. BridgeServer's "shared" sentinel, or
+            # a typo) would be stored as the cache object and crash at the
+            # first ingest — fail at the call site instead.
+            raise ValueError(
+                'verify_cache must be "default", a VerifiedVoteCache, or None'
+            )
+        self._verify_cache: VerifiedVoteCache | None = (
+            VerifiedVoteCache() if verify_cache == "default" else verify_cache
+        )
+        # Scheme-identity namespace for admission keys: a shared cache
+        # serving engines with different signature schemes must never
+        # cross-serve verdicts (scheme A's True is not scheme B's).
+        scheme = type(signer)
+        self._verify_scheme_tag = hashlib_sha256_8(
+            f"{scheme.__module__}.{scheme.__qualname__}".encode()
+        )
         self._event_bus: ConsensusEventBus[Scope] = (
             event_bus if event_bus is not None else BroadcastEventBus()
         )
@@ -302,6 +340,9 @@ class TpuConsensusEngine(Generic[Scope]):
         self._m_verify = self.metrics.histogram(VERIFY_BATCH_SECONDS)
         self._m_chain = self.metrics.histogram(CHAIN_KERNEL_SECONDS)
         self._m_device = self.metrics.histogram(DEVICE_INGEST_SECONDS)
+        self._m_suffix_len = self.metrics.histogram(
+            CHAIN_SUFFIX_LENGTH, DEFAULT_SIZE_BUCKETS
+        )
         # Per-proposal lifecycle timelines (created → first_vote → decided /
         # timed_out), feeding the decision-latency histogram.
         self._timelines = TimelineStore(
@@ -384,6 +425,10 @@ class TpuConsensusEngine(Generic[Scope]):
 
     def pool(self) -> ProposalPool:
         return self._pool
+
+    def verify_cache(self) -> VerifiedVoteCache | None:
+        """The memoized-admission cache (None when disabled)."""
+        return self._verify_cache
 
     @property
     def _scheme(self) -> type[ConsensusSignatureScheme]:
@@ -822,11 +867,29 @@ class TpuConsensusEngine(Generic[Scope]):
             raise ProposalAlreadyExist()
         wall0 = time.time()
         config = self._resolve_config(scope, config, proposal)
+        # Fail-fast BEFORE the signature prepass, preserving the scalar
+        # path's zero-crypto rejection of expired gossip (validate_proposal
+        # re-runs the same check first, so error precedence is unchanged —
+        # an attacker redelivering expired chains must not be able to buy
+        # ECDSA work or churn the shared cache's LRU).
+        validate_proposal_timestamp(proposal.expiration_timestamp, now)
+        # Admission cache for the embedded chain: verdicts for known votes
+        # come from the cache, the rest from one batched verify (None
+        # disables the prepass entirely — from_proposal then verifies each
+        # vote inline, the original scalar flow).
+        sv = ch = None
+        if proposal.votes and self._verify_cache is not None:
+            sv, ch = self._cached_verify(proposal.votes)
         # The scalar oracle replays embedded votes with exact reference
         # semantics (chain validation, per-vote ECDSA, round caps); the dense
         # row is loaded from its final state.
         session, transition = ConsensusSession.from_proposal(
-            proposal.clone(), self._scheme, config, now
+            proposal.clone(),
+            self._scheme,
+            config,
+            now,
+            sig_verdicts=sv,
+            computed_hashes=ch,
         )
         # Event before save, as in the reference (src/service.rs:275-277).
         if transition.is_reached and self._owns_replicated_event():
@@ -878,33 +941,49 @@ class TpuConsensusEngine(Generic[Scope]):
             raise ValueError("configs must supply one entry per item")
         statuses = [int(StatusCode.OK)] * len(items)
 
-        # Bulk signature verification across every embedded vote.
-        flat_ids: list[bytes] = []
-        flat_payloads: list[bytes] = []
-        flat_sigs: list[bytes] = []
-        spans: list[tuple[int, int]] = []  # (start, count) per item
-        for scope, proposal in items:
-            start = len(flat_ids)
-            for vote in proposal.votes:
-                flat_ids.append(vote.vote_owner)
-                flat_payloads.append(vote.signing_payload())
-                flat_sigs.append(vote.signature)
+        # Items that cannot pass — already registered at entry, or already
+        # expired — are excluded from the verification prepass and the
+        # chain kernel: under gossip redelivery the same vote-carrying
+        # proposal arrives over and over, and re-verifying a chain that is
+        # about to be dropped anyway was the per-delivery O(chain)
+        # redelivery tax (expired chains are the same attack surface —
+        # buying ECDSA work and churning the shared cache's LRU with a
+        # stale proposal must not be possible on ANY entry point). Their
+        # statuses come from the final loop's inline gauntlet, which
+        # raises ProposalExpired / reports PROPOSAL_ALREADY_EXIST before
+        # any signature work — exact scalar error precedence preserved.
+        skip = [
+            (scope, proposal.proposal_id) in self._index
+            or now >= proposal.expiration_timestamp
+            for scope, proposal in items
+        ]
+
+        # Bulk signature verification across every embedded vote of the
+        # surviving items, through the admission cache: identical votes
+        # appearing across many chains collapse to one verify item, known
+        # votes to none (plain one-shot verify_batch when the cache is
+        # disabled — see _cached_verify).
+        flat_votes: list[Vote] = []
+        spans: list[tuple[int, int] | None] = []  # (start, count) per item
+        for i, (scope, proposal) in enumerate(items):
+            if skip[i]:
+                spans.append(None)
+                continue
+            start = len(flat_votes)
+            flat_votes.extend(proposal.votes)
             spans.append((start, len(proposal.votes)))
         verdicts: list = []
-        if flat_ids:
-            with observed_span(
-                self.tracer,
-                "engine.verify_batch",
-                self._m_verify,
-                votes=len(flat_ids),
-            ):
-                verdicts = self._scheme.verify_batch(
-                    flat_ids, flat_payloads, flat_sigs
-                )
+        vote_hashes: list = []
+        if flat_votes:
+            verdicts, vote_hashes = self._cached_verify(flat_votes)
 
         # Bulk chain validation on device (only chains that need it).
         chain_errors: dict[int, ConsensusError | None] = {}
-        chain_idx = [i for i, (_, p) in enumerate(items) if len(p.votes) > 1]
+        chain_idx = [
+            i
+            for i, (_, p) in enumerate(items)
+            if not skip[i] and len(p.votes) > 1
+        ]
         if chain_idx:
             pad = max(len(items[i][1].votes) for i in chain_idx)
             packs = [pack_chain(items[i][1].votes, pad_to=pad) for i in chain_idx]
@@ -936,7 +1015,20 @@ class TpuConsensusEngine(Generic[Scope]):
             if (scope, proposal.proposal_id) in self._index:
                 statuses[i] = int(StatusCode.PROPOSAL_ALREADY_EXIST)
                 continue
-            start, count = spans[i]
+            if spans[i] is None:
+                # Nothing precomputed for this item: expired at entry
+                # (the inline gauntlet below raises ProposalExpired
+                # before any signature work), or registered at entry but
+                # freed mid-batch by an earlier item's per-scope-cap
+                # eviction — either way, run the full scalar gauntlet, as
+                # a sequential process_incoming_proposal would.
+                sv = ch = None
+                chain_error = COMPUTE_CHAIN
+            else:
+                start, count = spans[i]
+                sv = verdicts[start : start + count] if count else None
+                ch = vote_hashes[start : start + count] if count else None
+                chain_error = chain_errors.get(i)
             try:
                 config = self._resolve_config(
                     scope, configs[i] if configs is not None else None, proposal
@@ -946,8 +1038,9 @@ class TpuConsensusEngine(Generic[Scope]):
                     self._scheme,
                     config,
                     now,
-                    sig_verdicts=verdicts[start : start + count] if count else None,
-                    chain_error=chain_errors.get(i),
+                    sig_verdicts=sv,
+                    chain_error=chain_error,
+                    computed_hashes=ch,
                 )
                 if transition.is_reached and self._owns_replicated_event():
                     self._emit(
@@ -962,6 +1055,226 @@ class TpuConsensusEngine(Generic[Scope]):
             except ConsensusError as exc:
                 statuses[i] = int(exc.code)
         return statuses
+
+    # ── Gossip delivery: create-or-extend (chain-prefix watermark) ─────
+
+    def deliver_proposal(
+        self,
+        scope: Scope,
+        proposal: Proposal,
+        now: int,
+        config: ConsensusConfig | None = None,
+    ) -> int:
+        """Scalar :meth:`deliver_proposals` (one StatusCode int)."""
+        return self.deliver_proposals(
+            [(scope, proposal)], now,
+            configs=[config] if config is not None else None,
+        )[0]
+
+    def deliver_proposals(
+        self,
+        items: "list[tuple[Scope, Proposal]]",
+        now: int,
+        configs: "list[ConsensusConfig | None] | None" = None,
+    ) -> "list[int]":
+        """Gossip-facing delivery of (possibly vote-carrying) proposals:
+        create unknown sessions, EXTEND known ones along the validated-chain
+        watermark, and absorb pure redeliveries for free.
+
+        The reference protocol gossips growing vote chains; its
+        ``process_incoming_proposal`` rejects any redelivery outright
+        (ProposalAlreadyExist), forcing embedders to re-feed every embedded
+        vote through the vote path — O(chain) signature checks per
+        delivery, O(L²) for an incrementally grown chain. This entry point
+        is the amortized alternative. Per item:
+
+        - unknown ``(scope, proposal_id)``: the full
+          :meth:`ingest_proposals` gauntlet (batched, cache-aware);
+          status as that path reports it;
+        - known, and the incoming chain strictly extends the accepted one
+          (every accepted vote's hash matches positionally — the
+          watermark): ONLY the suffix is hash/signature/chain-checked
+          (cache-aware) and applied through the batch vote path. Status
+          OK when every suffix vote landed (duplicates from concurrent
+          vote gossip and post-decision extras are absorbed), else the
+          first hard per-vote error. Admission failures apply nothing
+          (checked up front); apply-stage rejections — capacity, round
+          caps — leave earlier suffix votes applied, exactly as feeding
+          the suffix through the per-vote gossip path would;
+        - known otherwise — identical chain, shorter chain, fork before
+          the watermark, or a session whose chain was retained through
+          the columnar path (merged order not positionally comparable):
+          PROPOSAL_ALREADY_EXIST with zero crypto, exactly what
+          process_incoming_proposal reports for a redelivery.
+
+        Items are processed STRICTLY in order, each against the state the
+        previous items left: a batch call is definitionally equivalent to
+        the same deliveries made one by one (so ``[create X, extend X]``
+        extends, and a same-batch duplicate settles as a redelivery).
+        That equivalence is load-bearing for durability — the WAL chunks
+        oversized KIND_DELIVER records into consecutive smaller batches
+        and replays them as separate calls. Consecutive UNKNOWN items
+        with distinct pids are still dispatched as one
+        :meth:`ingest_proposals` call (one verify batch, one chain-kernel
+        dispatch) — safe because that path also processes in order — and
+        repeated signatures across items cost one verify via the
+        admission cache, so ordering does not forfeit the batch's
+        amortization.
+
+        Multi-host: a device-pooled session owned by another process
+        reports SESSION_NOT_FOUND *before* any suffix validation — the
+        relay routes on that status, and a misrouted-but-invalid delivery
+        must look the same as a misrouted-valid one (the ingest_votes
+        convention).
+
+        Semantics with the verify cache disabled are identical (the
+        watermark is structural, not cached); only the signature work
+        changes. Events/decisions fire exactly as the underlying
+        create/vote paths emit them.
+        """
+        if configs is not None and len(configs) != len(items):
+            raise ValueError("configs must supply one entry per item")
+        statuses: list[int] = [0] * len(items)
+        run: list[int] = []  # consecutive unknown items, distinct pids
+        run_keys: set = set()
+
+        def flush_run() -> None:
+            if not run:
+                return
+            sub = self.ingest_proposals(
+                [items[j] for j in run],
+                now,
+                configs=(
+                    [configs[j] for j in run] if configs is not None else None
+                ),
+            )
+            for j, code in zip(run, sub):
+                statuses[j] = int(code)
+            run.clear()
+            run_keys.clear()
+
+        for k, (scope, proposal) in enumerate(items):
+            key = (scope, proposal.proposal_id)
+            # A known pid — or a pid this run is about to register — must
+            # see the state all earlier items produced: flush first.
+            if key in self._index or key in run_keys:
+                flush_run()
+            slot = self._index.get(key)
+            if slot is None:
+                run.append(k)
+                run_keys.add(key)
+                continue
+            record = self._records[slot]
+            if (
+                self._multihost
+                and record.session is None
+                and not self._owns_slot(slot)
+            ):
+                # Misrouted, rejected BEFORE validation (see docstring).
+                statuses[k] = int(StatusCode.SESSION_NOT_FOUND)
+                continue
+            suffix = self._extension_suffix(record, proposal)
+            statuses[k] = (
+                self._apply_chain_suffix(record, suffix, now)
+                if suffix
+                else int(StatusCode.PROPOSAL_ALREADY_EXIST)
+            )
+        flush_run()
+        return statuses
+
+    def _extension_suffix(
+        self, record: SessionRecord[Scope], proposal: Proposal
+    ) -> "list[Vote] | None":
+        """Suffix of ``proposal.votes`` beyond the session's accepted chain,
+        or None when the incoming chain is not a strict extension of it
+        (shorter, equal-length, forked before the watermark, or the
+        accepted chain is partly columnar-retained wire whose merged order
+        is not positionally comparable). The prefix compare is bytes
+        equality over already-validated hashes — no crypto."""
+        if record.retained_wire:
+            return None
+        accepted = record.proposal.votes
+        incoming = proposal.votes
+        if len(incoming) <= len(accepted):
+            return None
+        for ours, theirs in zip(accepted, incoming):
+            if ours.vote_hash != theirs.vote_hash:
+                return None
+        return [v.clone() for v in incoming[len(accepted) :]]
+
+    def _apply_chain_suffix(
+        self, record: SessionRecord[Scope], suffix: "list[Vote]", now: int
+    ) -> int:
+        """Validate and apply a watermark extension: hash/signature checks
+        (admission cache) and chain-link checks cover ONLY the suffix — the
+        accepted prefix was validated when it was accepted. Admission is
+        all-or-nothing (the first bad suffix vote rejects the delivery
+        before anything mutates); APPLY-stage rejections — capacity,
+        round caps — mirror the per-vote gossip path this call amortizes:
+        earlier suffix votes stay applied and the first hard code is
+        returned, exactly the state feeding the suffix through
+        process_incoming_vote one by one would leave."""
+        proposal = record.proposal
+        verdicts, hashes = self._cached_verify(suffix)
+        for i, vote in enumerate(suffix):
+            if vote.proposal_id != proposal.proposal_id:
+                return int(StatusCode.VOTE_PROPOSAL_ID_MISMATCH)
+            try:
+                validate_vote(
+                    vote,
+                    self._scheme,
+                    proposal.expiration_timestamp,
+                    proposal.timestamp,
+                    now,
+                    sig_verdict=verdicts[i],
+                    computed_hash=hashes[i],
+                )
+            except ConsensusError as exc:
+                return int(exc.code)
+        code = self._validate_suffix_chain(record, suffix)
+        if code:
+            return code
+        sub = self.ingest_votes(
+            [(record.scope, vote) for vote in suffix], now, pre_validated=True
+        )
+        # The histogram is documented as "votes applied per watermark
+        # extension": observe what actually LANDED (apply-stage rejections
+        # and already-voted absorptions excluded), so rejected deliveries
+        # and partial applies never read as healthy extension traffic.
+        applied = int(np.sum(np.asarray(sub) == int(StatusCode.OK)))
+        if applied:
+            self._m_suffix_len.observe(applied)
+            self.tracer.count("engine.chain_extensions")
+        # Soft codes a live session legitimately produces for chain votes
+        # that raced concurrent gossip: the owner already voted via the
+        # vote path, or the session decided mid-suffix. Anything else is a
+        # hard error the caller must see.
+        soft = (
+            int(StatusCode.OK),
+            int(StatusCode.ALREADY_REACHED),
+            int(StatusCode.DUPLICATE_VOTE),
+            int(StatusCode.USER_ALREADY_VOTED),
+        )
+        for code in sub:
+            if int(code) not in soft:
+                return int(code)
+        return int(StatusCode.OK)
+
+    def _validate_suffix_chain(
+        self, record: SessionRecord[Scope], suffix: "list[Vote]"
+    ) -> int:
+        """protocol.validate_vote_chain over accepted + suffix, checked
+        from the watermark onward (``start``): the accepted prefix's links
+        were validated at acceptance, and the chain rules live in exactly
+        one place. Returns a StatusCode int, 0 when valid."""
+        try:
+            validate_vote_chain(
+                record.proposal.votes + suffix,
+                start=len(record.proposal.votes),
+            )
+        except ConsensusError as exc:
+            return int(exc.code)
+        return 0
 
     def _register(
         self,
@@ -1087,6 +1400,81 @@ class TpuConsensusEngine(Generic[Scope]):
 
     # ── Voting ─────────────────────────────────────────────────────────
 
+    def _cached_verify(
+        self, votes: "list[Vote]"
+    ) -> "tuple[list, list[bytes]]":
+        """Signature verdicts for ``votes`` through the admission cache:
+        in-batch dedup (identical votes across many chains collapse to one
+        verify item), cache consultation, ONE scheme.verify_batch over the
+        surviving misses, verdict fan-out, cache population. Returns
+        (verdicts, computed_hashes) aligned with ``votes`` — callers feed
+        both into validate_vote so the SHA pass here is the only one.
+
+        With the cache disabled this is a plain batched verify (identical
+        to the pre-cache flow). Rows whose embedded ``vote_hash`` field
+        does not match the recomputed digest — or with structurally empty
+        owner/hash/signature — are neither verified nor cached: their
+        admission key would not determine the signing payload (see the
+        verify_cache module docstring), and validate_vote rejects them
+        before ever consulting the signature verdict."""
+        hashes = [compute_vote_hash(v) for v in votes]
+        if self._verify_cache is None:
+            if not votes:
+                return [], hashes
+            with observed_span(
+                self.tracer,
+                "engine.verify_batch",
+                self._m_verify,
+                votes=len(votes),
+            ):
+                verdicts = self._scheme.verify_batch(
+                    [v.vote_owner for v in votes],
+                    [v.signing_payload() for v in votes],
+                    [v.signature for v in votes],
+                )
+            return list(verdicts), hashes
+        cache = self._verify_cache
+        verdicts: list = [False] * len(votes)
+        rows: list[int] = []
+        keys: list[bytes] = []
+        for i, (vote, digest) in enumerate(zip(votes, hashes)):
+            if (
+                not vote.vote_owner
+                or not vote.signature
+                or vote.vote_hash != digest
+            ):
+                continue  # verdict unreachable in validate_vote's ordering
+            rows.append(i)
+            keys.append(
+                VerifiedVoteCache.key(
+                    digest, vote.signature, self._verify_scheme_tag
+                )
+            )
+        miss_rows: dict[bytes, list[int]] = {}
+        for i, key, hit in zip(rows, keys, cache.get_many(keys)):
+            if hit is not MISS:
+                verdicts[i] = hit
+            else:
+                miss_rows.setdefault(key, []).append(i)
+        if miss_rows:
+            rep = [rows[0] for rows in miss_rows.values()]
+            with observed_span(
+                self.tracer,
+                "engine.verify_batch",
+                self._m_verify,
+                votes=len(rep),
+            ):
+                fresh = self._scheme.verify_batch(
+                    [votes[i].vote_owner for i in rep],
+                    [votes[i].signing_payload() for i in rep],
+                    [votes[i].signature for i in rep],
+                )
+            for (_, miss), verdict in zip(miss_rows.items(), fresh):
+                for i in miss:
+                    verdicts[i] = verdict
+            cache.put_many(list(zip(miss_rows, fresh)))
+        return verdicts, hashes
+
     def cast_vote(self, scope: Scope, proposal_id: int, choice: bool, now: int) -> Vote:
         """Sign, chain, and apply this peer's vote
         (reference: src/service.rs:216-237)."""
@@ -1160,9 +1548,15 @@ class TpuConsensusEngine(Generic[Scope]):
         # Batched signature verification: one scheme call for the whole batch
         # (native runtime: one GIL-releasing threaded C call). Verdicts are
         # injected into the per-vote check sequence, preserving exact scalar
-        # error precedence.
+        # error precedence. With the admission cache enabled the prepass
+        # also covers batch == 1 (the process_incoming_vote / bridge scalar
+        # path hits the cache too), dedups identical votes within the
+        # batch, and only the cache misses reach the scheme.
         sig_verdicts: dict[int, object] = {}
-        if not pre_validated and batch > 1:
+        vote_hashes: dict[int, bytes] = {}
+        if not pre_validated and (
+            batch > 1 or (batch == 1 and self._verify_cache is not None)
+        ):
             idxs = [
                 i
                 for i, (scope, vote) in enumerate(items)
@@ -1170,18 +1564,11 @@ class TpuConsensusEngine(Generic[Scope]):
                 and (slot < 0 or self._owns_slot(slot))  # skip misrouted rows
             ]
             if idxs:
-                with observed_span(
-                    self.tracer,
-                    "engine.verify_batch",
-                    self._m_verify,
-                    votes=len(idxs),
-                ):
-                    verdicts = self._scheme.verify_batch(
-                        [items[i][1].vote_owner for i in idxs],
-                        [items[i][1].signing_payload() for i in idxs],
-                        [items[i][1].signature for i in idxs],
-                    )
+                verdicts, hashes = self._cached_verify(
+                    [items[i][1] for i in idxs]
+                )
                 sig_verdicts = dict(zip(idxs, verdicts))
+                vote_hashes = dict(zip(idxs, hashes))
 
         for i, (scope, vote) in enumerate(items):
             slot = self._index.get((scope, vote.proposal_id))
@@ -1208,6 +1595,7 @@ class TpuConsensusEngine(Generic[Scope]):
                         record.proposal.timestamp,
                         now,
                         sig_verdict=sig_verdicts.get(i),
+                        computed_hash=vote_hashes.get(i),
                     )
                 except ConsensusError as exc:
                     statuses[i] = int(exc.code)
@@ -2934,6 +3322,8 @@ for _name in (
     "create_proposals_multi",
     "process_incoming_proposal",
     "ingest_proposals",
+    "deliver_proposal",
+    "deliver_proposals",
     "ingest_columnar",
     "ingest_columnar_multi",
     "voter_gid",

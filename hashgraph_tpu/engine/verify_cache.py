@@ -1,0 +1,204 @@
+"""Memoized vote-admission verdicts: verify each unique vote ONCE.
+
+The reference protocol gossips *growing vote chains*: a chain of length L
+delivered one extension at a time re-presents every earlier vote L times,
+and gossip redelivery re-presents whole chains verbatim. Signature
+verification is the engine's host-side wall (BENCHMARKS.md: ~92% of the
+validated end-to-end path is ECDSA), so re-verifying a vote that was
+already admitted — or already rejected — is the single largest avoidable
+cost under redelivery: O(L²) signature checks for an incrementally grown
+chain. This module memoizes the *signature verdict* per unique
+(vote content, signature) pair so that cost collapses to O(L).
+
+What is cached — and why it is safe:
+
+- The key is ``compute_vote_hash(vote) + vote.signature``. The computed
+  hash covers every signed field except the signature and the embedded
+  ``vote_hash`` field itself; ``validate_vote`` checks
+  ``vote.vote_hash == computed`` *before* consulting the signature
+  verdict, so at every consultation point the key fully determines the
+  signing payload. A forged signature therefore lives under its own key
+  and can never poison (or be served) the verdict of the honestly signed
+  vote. Callers must only consult/populate the cache for votes whose
+  embedded hash matches the recomputed one (the engine's
+  ``_cached_verify`` enforces this).
+- The value is exactly what ``ConsensusSignatureScheme.verify_batch``
+  yields per item: ``True``, ``False``, or the ``ConsensusSchemeError``
+  that scalar ``verify`` would have raised. Negative verdicts are cached
+  too — a peer replaying a known-bad vote costs a dict probe, not an
+  ECDSA recover.
+- Context-dependent checks (replay guard, expiry, duplicate detection,
+  chain linkage) are NOT cached: they depend on the receiving session and
+  on ``now``, and they are cheap. The cache changes where signature
+  verification happens, never its verdict — an engine with the cache
+  disabled (``verify_cache=None``) produces byte-for-byte identical
+  statuses.
+
+The cache is bounded (entry count and approximate byte caps) with LRU
+eviction, and thread-safe so one instance can be shared by every peer
+engine in a :class:`~hashgraph_tpu.bridge.BridgeServer` process — a vote
+gossiped to N co-hosted peers is then verified once, not N times.
+Hit/miss/negative-hit/evict counters land on the process-wide metrics
+registry (:mod:`hashgraph_tpu.obs`) and appear in ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from ..obs import (
+    VERIFY_CACHE_EVICTIONS_TOTAL,
+    VERIFY_CACHE_HITS_TOTAL,
+    VERIFY_CACHE_MISSES_TOTAL,
+    VERIFY_CACHE_NEGATIVE_HITS_TOTAL,
+)
+from ..obs import registry as default_registry
+
+__all__ = ["VerifiedVoteCache", "MISS"]
+
+# Distinct sentinel for "no cached verdict": False and scheme errors are
+# real (negative) verdicts, so None/False cannot signal a miss.
+MISS = object()
+
+# Flat per-entry overhead charged against max_bytes on top of the key
+# length: OrderedDict node + key bytes object headers + value slot. An
+# estimate (CPython internals vary by version) — the byte cap is a
+# sizing guardrail, not an accounting ledger.
+_ENTRY_OVERHEAD = 160
+
+
+class VerifiedVoteCache:
+    """Bounded, thread-safe LRU map: vote admission key -> signature verdict.
+
+    ``max_entries`` bounds the entry count; ``max_bytes`` (optional)
+    additionally bounds the approximate resident size (keys + flat
+    per-entry overhead). Either cap triggers least-recently-*used*
+    eviction — a hit refreshes recency, so hot chain prefixes survive
+    churny gossip tails.
+    """
+
+    def __init__(
+        self, max_entries: int = 1 << 16, max_bytes: int | None = None
+    ):
+        if max_entries <= 0:
+            raise ValueError("max_entries must be positive")
+        if max_bytes is not None and max_bytes <= 0:
+            raise ValueError("max_bytes must be positive when set")
+        self.max_entries = int(max_entries)
+        self.max_bytes = max_bytes
+        self._entries: OrderedDict[bytes, object] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        reg = default_registry
+        self._m_hits = reg.counter(VERIFY_CACHE_HITS_TOTAL)
+        self._m_misses = reg.counter(VERIFY_CACHE_MISSES_TOTAL)
+        self._m_negative_hits = reg.counter(VERIFY_CACHE_NEGATIVE_HITS_TOTAL)
+        self._m_evictions = reg.counter(VERIFY_CACHE_EVICTIONS_TOTAL)
+
+    @staticmethod
+    def key(
+        computed_hash: bytes, signature: bytes, scheme_tag: bytes = b""
+    ) -> bytes:
+        """Admission key for one vote. ``computed_hash`` MUST be
+        ``protocol.compute_vote_hash(vote)`` and the caller must have
+        checked ``vote.vote_hash == computed_hash`` (see module
+        docstring) — an unchecked embedded hash would let a mismatched
+        payload share a key with the canonical one. ``scheme_tag``
+        namespaces verdicts by signature-scheme identity (the engine
+        derives it from its scheme type): one cache instance shared by
+        engines with DIFFERENT schemes must never serve scheme A's
+        verdict for scheme B's verification of the same bytes."""
+        return scheme_tag + computed_hash + signature
+
+    def get(self, key: bytes):
+        """Cached verdict for ``key``, or :data:`MISS`. A hit refreshes
+        LRU recency; negative verdicts (False / scheme error) count
+        separately so poisoning attempts are visible in metrics."""
+        with self._lock:
+            verdict = self._entries.get(key, MISS)
+            if verdict is MISS:
+                self._m_misses.inc()
+                return MISS
+            self._entries.move_to_end(key)
+        self._m_hits.inc()
+        if verdict is not True:
+            self._m_negative_hits.inc()
+        return verdict
+
+    def get_many(self, keys: "list[bytes]") -> list:
+        """Batched :meth:`get`: one lock acquisition and one counter
+        update for the whole batch — the engine's per-batch prepass calls
+        this so a cache consult costs dict probes, not per-vote lock and
+        metrics traffic. Returns one verdict-or-:data:`MISS` per key."""
+        hits = misses = negatives = 0
+        out = []
+        entries = self._entries
+        with self._lock:
+            for key in keys:
+                verdict = entries.get(key, MISS)
+                if verdict is MISS:
+                    misses += 1
+                else:
+                    entries.move_to_end(key)
+                    hits += 1
+                    negatives += verdict is not True
+                out.append(verdict)
+        if hits:
+            self._m_hits.inc(hits)
+        if misses:
+            self._m_misses.inc(misses)
+        if negatives:
+            self._m_negative_hits.inc(negatives)
+        return out
+
+    def put(self, key: bytes, verdict) -> None:
+        """Store one verdict, evicting LRU entries past either cap."""
+        self.put_many([(key, verdict)])
+
+    def put_many(self, items: "list[tuple[bytes, object]]") -> None:
+        """Batched :meth:`put` (one lock acquisition, one eviction sweep)."""
+        evicted = 0
+        with self._lock:
+            for key, verdict in items:
+                old = self._entries.pop(key, MISS)
+                if old is not MISS:
+                    self._bytes -= len(key) + _ENTRY_OVERHEAD
+                self._entries[key] = verdict
+                self._bytes += len(key) + _ENTRY_OVERHEAD
+            while len(self._entries) > self.max_entries or (
+                self.max_bytes is not None
+                and self._bytes > self.max_bytes
+                and len(self._entries) > 1
+            ):
+                victim, _ = self._entries.popitem(last=False)
+                self._bytes -= len(victim) + _ENTRY_OVERHEAD
+                evicted += 1
+        if evicted:
+            self._m_evictions.inc(evicted)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def bytes_used(self) -> int:
+        """Approximate resident bytes (keys + flat per-entry overhead)."""
+        with self._lock:
+            return self._bytes
+
+    def stats(self) -> dict:
+        """Point-in-time sizing readout (the hit/miss/evict *rates* live
+        on the process-wide metrics registry, not per instance)."""
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes_used": self._bytes,
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+            }

@@ -37,10 +37,12 @@ wal_recover_seconds                             histogram  DurableEngine.recover
 hashgraph_live_proposals                        gauge      engines (tracked sessions)
 hashgraph_vote_table_occupancy                  gauge      engines (claimed pool slots)
 wal_segment_count / wal_segment_bytes           gauge      WAL writers (live log footprint)
+hashgraph_chain_suffix_length                   histogram  engine (votes applied per watermark extension)
 hashgraph_votes_total / _accepted_total         counter    engine ingest paths
 hashgraph_proposals_created_total               counter    engine registration
 hashgraph_decisions_total                       counter    engine transitions
 hashgraph_timeouts_fired_total                  counter    engine timeout paths
+hashgraph_verify_cache_{hits,misses,negative_hits,evictions}_total  counter  VerifiedVoteCache (memoized admission)
 bridge_requests_total / bridge_errors_total     counter    bridge dispatch loop
 flight_dumps_total                              counter    flight recorder dump sites
 wal_checkpoints_total                           counter    DurableEngine checkpoints
@@ -94,6 +96,8 @@ VOTE_TABLE_OCCUPANCY = "hashgraph_vote_table_occupancy"
 WAL_SEGMENT_COUNT = "wal_segment_count"
 WAL_SEGMENT_BYTES = "wal_segment_bytes"
 
+CHAIN_SUFFIX_LENGTH = "hashgraph_chain_suffix_length"
+
 VOTES_TOTAL = "hashgraph_votes_total"
 VOTES_ACCEPTED_TOTAL = "hashgraph_votes_accepted_total"
 PROPOSALS_CREATED_TOTAL = "hashgraph_proposals_created_total"
@@ -103,6 +107,10 @@ BRIDGE_REQUESTS_TOTAL = "bridge_requests_total"
 BRIDGE_ERRORS_TOTAL = "bridge_errors_total"
 FLIGHT_DUMPS_TOTAL = "flight_dumps_total"
 WAL_CHECKPOINTS_TOTAL = "wal_checkpoints_total"
+VERIFY_CACHE_HITS_TOTAL = "hashgraph_verify_cache_hits_total"
+VERIFY_CACHE_MISSES_TOTAL = "hashgraph_verify_cache_misses_total"
+VERIFY_CACHE_NEGATIVE_HITS_TOTAL = "hashgraph_verify_cache_negative_hits_total"
+VERIFY_CACHE_EVICTIONS_TOTAL = "hashgraph_verify_cache_evictions_total"
 BUILD_INFO = "hashgraph_build_info"
 
 # Process-wide default registry (mirrors tracing.tracer's role).
@@ -122,6 +130,7 @@ def _install_well_known(reg: MetricsRegistry) -> None:
     ):
         reg.histogram(name, DEFAULT_TIME_BUCKETS)
     reg.histogram(INGEST_BATCH_SIZE, DEFAULT_SIZE_BUCKETS)
+    reg.histogram(CHAIN_SUFFIX_LENGTH, DEFAULT_SIZE_BUCKETS)
     for name in (
         LIVE_PROPOSALS,
         VOTE_TABLE_OCCUPANCY,
@@ -139,6 +148,10 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         BRIDGE_ERRORS_TOTAL,
         FLIGHT_DUMPS_TOTAL,
         WAL_CHECKPOINTS_TOTAL,
+        VERIFY_CACHE_HITS_TOTAL,
+        VERIFY_CACHE_MISSES_TOTAL,
+        VERIFY_CACHE_NEGATIVE_HITS_TOTAL,
+        VERIFY_CACHE_EVICTIONS_TOTAL,
     ):
         reg.counter(name)
     reg.info(BUILD_INFO).set(

@@ -138,14 +138,17 @@ def validate_proposal(
     now: int,
     sig_verdicts=None,
     chain_error=COMPUTE_CHAIN,
+    computed_hashes=None,
 ) -> None:
     """Validate a proposal and all its votes (reference: src/utils.rs:106-120).
 
-    ``sig_verdicts``/``chain_error`` optionally inject precomputed results
-    from the batched paths (scheme.verify_batch / the device chain kernel):
+    ``sig_verdicts``/``chain_error``/``computed_hashes`` optionally inject
+    precomputed results from the batched paths (scheme.verify_batch / the
+    device chain kernel / a prior ``compute_vote_hash`` pass):
     ``sig_verdicts`` is one verdict per vote in order; ``chain_error`` is
     None (chain valid) or the exception to raise at the chain-check
-    position. Injection changes where the work happens, not the semantics.
+    position; ``computed_hashes`` is one digest per vote in order.
+    Injection changes where the work happens, not the semantics.
     """
     validate_proposal_timestamp(proposal.expiration_timestamp, now)
     for i, vote in enumerate(proposal.votes):
@@ -158,6 +161,9 @@ def validate_proposal(
             proposal.timestamp,
             now,
             sig_verdict=sig_verdicts[i] if sig_verdicts is not None else None,
+            computed_hash=(
+                computed_hashes[i] if computed_hashes is not None else None
+            ),
         )
     if chain_error is COMPUTE_CHAIN:
         validate_vote_chain(proposal.votes)
@@ -172,6 +178,7 @@ def validate_vote(
     creation_time: int,
     now: int,
     sig_verdict=None,
+    computed_hash=None,
 ) -> None:
     """Validate a single vote: structure, hash, signature, replay, expiry.
 
@@ -182,7 +189,10 @@ def validate_vote(
     the scheme's batched verification (bool, or the ConsensusSchemeError
     ``verify`` would have raised) — the batch ingest path verifies all
     signatures in one native call, then replays this check sequence per
-    vote. Semantics are identical to calling ``scheme.verify`` inline.
+    vote. ``computed_hash`` optionally injects the caller's own
+    ``compute_vote_hash(vote)`` result (the verify-cache prepass hashes
+    every vote to build its keys; recomputing here would double the SHA
+    work per vote). Semantics are identical to the inline computations.
     """
     if not vote.vote_owner:
         raise EmptyVoteOwner()
@@ -191,7 +201,9 @@ def validate_vote(
     if not vote.signature:
         raise EmptySignature()
 
-    expected_hash = compute_vote_hash(vote)
+    expected_hash = (
+        computed_hash if computed_hash is not None else compute_vote_hash(vote)
+    )
     if vote.vote_hash != expected_hash:
         raise InvalidVoteHash()
 
@@ -213,7 +225,7 @@ def validate_vote(
         raise VoteExpired()
 
 
-def validate_vote_chain(votes: list[Vote]) -> None:
+def validate_vote_chain(votes: list[Vote], start: int = 0) -> None:
     """Validate the hashgraph chain structure over an ordered vote list
     (reference: src/utils.rs:175-215).
 
@@ -222,6 +234,13 @@ def validate_vote_chain(votes: list[Vote]) -> None:
       ``vote_hash``, with non-decreasing timestamps;
     - a non-empty ``parent_hash`` must resolve to an earlier-indexed vote by
       the same owner with timestamp <= this vote's.
+
+    ``start`` restricts WHICH indices are checked (the hash map still spans
+    the full list, preserving last-occurrence-wins): the engine's
+    validated-chain watermark passes the accepted prefix + suffix with
+    ``start`` at the watermark, so the suffix is checked against the full
+    chain without re-checking links the prefix already passed. The rules
+    themselves have exactly one home — this function.
     """
     if len(votes) <= 1:
         return
@@ -230,7 +249,8 @@ def validate_vote_chain(votes: list[Vote]) -> None:
     for idx, vote in enumerate(votes):
         hash_index[vote.vote_hash] = (vote.vote_owner, vote.timestamp, idx)
 
-    for idx, vote in enumerate(votes):
+    for idx in range(start, len(votes)):
+        vote = votes[idx]
         if idx > 0 and vote.received_hash:
             prev_vote = votes[idx - 1]
             if vote.received_hash != prev_vote.vote_hash:

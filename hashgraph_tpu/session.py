@@ -203,13 +203,20 @@ class ConsensusSession:
         now: int,
         sig_verdicts=None,
         chain_error=COMPUTE_CHAIN,
+        computed_hashes=None,
     ) -> tuple["ConsensusSession", SessionTransition]:
         """Validate a (possibly vote-carrying) proposal and build a session,
         replaying embedded votes from a clean round-1 state
         (reference: src/session.rs:198-221). ``sig_verdicts``/``chain_error``
-        inject batched-path results (see protocol.validate_proposal)."""
+        /``computed_hashes`` inject batched-path results (see
+        protocol.validate_proposal)."""
         validate_proposal(
-            proposal, scheme, now, sig_verdicts=sig_verdicts, chain_error=chain_error
+            proposal,
+            scheme,
+            now,
+            sig_verdicts=sig_verdicts,
+            chain_error=chain_error,
+            computed_hashes=computed_hashes,
         )
 
         existing_votes = [v.clone() for v in proposal.votes]
@@ -226,6 +233,7 @@ class ConsensusSession:
             now,
             sig_verdicts=sig_verdicts,
             chain_error=chain_error,
+            computed_hashes=computed_hashes,
         )
         return session, transition
 
@@ -277,6 +285,7 @@ class ConsensusSession:
         now: int,
         sig_verdicts=None,
         chain_error=COMPUTE_CHAIN,
+        computed_hashes=None,
     ) -> SessionTransition:
         """Batch-initialize: validate everything, then add atomically
         (reference: src/session.rs:253-298)."""
@@ -311,6 +320,9 @@ class ConsensusSession:
                 creation_time,
                 now,
                 sig_verdict=sig_verdicts[i] if sig_verdicts is not None else None,
+                computed_hash=(
+                    computed_hashes[i] if computed_hashes is not None else None
+                ),
             )
 
         self._check_round_limit(len(votes))

@@ -359,6 +359,40 @@ class DurableEngine:
             )
             return self._engine.ingest_proposals(items, now, configs=configs)
 
+    def deliver_proposal(self, scope, proposal, now, config=None):
+        with self._lock:
+            self._wal.append(
+                F.KIND_DELIVER,
+                F.encode_proposals(now, [(scope, proposal.encode(), config)]),
+            )
+            return self._engine.deliver_proposal(scope, proposal, now, config)
+
+    def deliver_proposals(self, items, now, configs=None):
+        """Create-or-extend gossip delivery, logged under KIND_DELIVER so
+        replay re-runs the watermark path (a KIND_PROPOSALS record would
+        replay as plain ingest and silently DROP the suffix votes an
+        extension applied live). Record splitting is safe because
+        deliver_proposals processes items strictly in order — a batch
+        call is definitionally equivalent to the same deliveries made as
+        consecutive smaller batches (the engine documents that guarantee
+        as load-bearing for exactly this splitting)."""
+        with self._lock:
+            self._append_split(
+                F.KIND_DELIVER,
+                [
+                    (
+                        scope,
+                        proposal.encode(),
+                        configs[i] if configs is not None else None,
+                    )
+                    for i, (scope, proposal) in enumerate(items)
+                ],
+                lambda its: F.encode_proposals(now, its),
+                F.PROPOSALS_LEAD_BYTES,
+                F.sizeof_proposal_item,
+            )
+            return self._engine.deliver_proposals(items, now, configs=configs)
+
     # ── Voting ─────────────────────────────────────────────────────────
 
     def cast_vote(self, scope, proposal_id, choice, now):

@@ -43,6 +43,13 @@ KIND_SCOPE_DELETE = 5  # batch of scopes dropped
 KIND_TIMEOUT = 6  # app-driven per-session timeout decision
 KIND_SWEEP = 7  # engine-level timeout sweep
 KIND_SNAPSHOT = 8  # snapshot watermark: records with lsn <= mark are covered
+# Gossip create-or-extend delivery (engine.deliver_proposals). Payload is
+# the KIND_PROPOSALS encoding verbatim; the kind byte alone routes replay
+# through the watermark path, because the same proposal bytes mean
+# different state transitions under deliver (extension applies a suffix)
+# vs ingest (redelivery rejects) — replay must re-run the call that was
+# acked, not a lookalike.
+KIND_DELIVER = 9
 
 KIND_NAMES = {
     KIND_PROPOSALS: "proposals",
@@ -53,6 +60,7 @@ KIND_NAMES = {
     KIND_TIMEOUT: "timeout",
     KIND_SWEEP: "sweep",
     KIND_SNAPSHOT: "snapshot",
+    KIND_DELIVER: "deliver",
 }
 
 # Scope-config record modes (the engine has three distinct mutation

@@ -221,6 +221,15 @@ def _apply(engine, kind: int, payload: bytes, stats: ReplayStats) -> None:
         configs = [config for _, _, config in items]
         engine.ingest_proposals(decoded, now, configs=configs)
         stats.proposals_replayed += len(decoded)
+    elif kind == F.KIND_DELIVER:
+        # Same payload as KIND_PROPOSALS, different entry point: the
+        # create-or-extend path is deterministic given engine state, so
+        # replay re-derives the live run's exact suffix applications.
+        now, items = F.decode_proposals(payload)
+        decoded = [(scope, Proposal.decode(wire)) for scope, wire, _ in items]
+        configs = [config for _, _, config in items]
+        engine.deliver_proposals(decoded, now, configs=configs)
+        stats.proposals_replayed += len(decoded)
     elif kind == F.KIND_VOTES:
         now, pre_validated, items = F.decode_votes(payload)
         decoded = [(scope, Vote.decode(wire)) for scope, wire in items]

@@ -1,0 +1,173 @@
+"""Property-based fuzz: amortized admission (verify cache + watermark)
+vs the uncached engine as oracle.
+
+The cache must change WHERE signature verification happens, never a
+verdict: for any delivery sequence — growth, redelivery, duplicate-laden
+batches, truncations, forks, corrupted signatures — a cache-on engine and
+a cache-off engine must report identical statuses and end in identical
+sessions. Hypothesis drives the sequence space far beyond the
+hand-written smoke cases in test_redelivery.py.
+"""
+
+import pytest
+
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings, strategies as st
+
+from hashgraph_tpu import (
+    CreateProposalRequest,
+    StubConsensusSigner,
+    build_vote,
+)
+from hashgraph_tpu.engine import TpuConsensusEngine, VerifiedVoteCache
+from hashgraph_tpu.engine.verify_cache import _ENTRY_OVERHEAD
+
+from common import NOW
+
+N_SIGNERS = 6
+SIGNERS = [StubConsensusSigner(bytes([i + 1]) * 20) for i in range(N_SIGNERS)]
+
+
+def build_chain(n_votes: int):
+    """A base proposal plus ``n_votes`` chain-linked stub votes."""
+    maker = TpuConsensusEngine(
+        StubConsensusSigner(b"\x42" * 20),
+        capacity=4,
+        voter_capacity=4,
+        verify_cache=None,
+    )
+    proposal = maker.create_proposal(
+        "s",
+        CreateProposalRequest(
+            name="p",
+            payload=b"x",
+            proposal_owner=b"o",
+            expected_voters_count=N_SIGNERS * 2,
+            expiration_timestamp=10_000,
+            liveness_criteria_yes=True,
+        ),
+        NOW,
+    )
+    chain = proposal.clone()
+    for i in range(n_votes):
+        chain.votes.append(
+            build_vote(chain, bool(i % 2), SIGNERS[i], NOW + 1 + i)
+        )
+    return proposal, chain
+
+
+# One delivery op: (kind, k) — kind selects the surface, k the chain cut.
+ops = st.lists(
+    st.tuples(
+        st.sampled_from(
+            ["deliver", "deliver_batch", "votes", "corrupt", "fork"]
+        ),
+        st.integers(min_value=0, max_value=N_SIGNERS),
+    ),
+    min_size=1,
+    max_size=8,
+)
+
+
+@settings(max_examples=25, deadline=None)
+@given(n_votes=st.integers(min_value=1, max_value=N_SIGNERS), script=ops)
+def test_cache_on_off_equivalence(n_votes, script):
+    proposal, chain = build_chain(n_votes)
+
+    def cut(k):
+        p = chain.clone()
+        p.votes = [v.clone() for v in chain.votes[: min(k, len(chain.votes))]]
+        return p
+
+    # Materialize every delivery payload ONCE, before the engine loop:
+    # build_vote mints random vote ids, so a fork crafted per-engine would
+    # differ between the two runs and the comparison would fuzz the
+    # payload generator instead of the cache.
+    deliveries = []
+    for kind, k in script:
+        if kind == "deliver":
+            deliveries.append(("deliver", cut(k)))
+        elif kind == "deliver_batch":
+            # Same item twice in one batch: the second must settle as a
+            # redelivery against the first's advanced watermark.
+            deliveries.append(("deliver_batch", cut(k)))
+        elif kind == "votes":
+            deliveries.append(("votes", k))
+        elif kind == "corrupt":
+            bad = cut(max(k, 1))
+            bad.votes[-1].signature = b"\x00" * 32
+            deliveries.append(("deliver", bad))
+        elif kind == "fork":
+            forked = cut(max(k, 1))
+            forked.votes[-1] = build_vote(
+                proposal, True, StubConsensusSigner(b"\x90" * 20), NOW + 60
+            )
+            deliveries.append(("deliver", forked))
+
+    outcomes = []
+    for cache in ("default", None):
+        engine = TpuConsensusEngine(
+            StubConsensusSigner(b"\x52" * 20),
+            capacity=8,
+            voter_capacity=4,  # < expected: host substrate, fast under CPU
+            verify_cache=cache,
+        )
+        log = []
+        for kind, payload in deliveries:
+            if kind == "deliver":
+                log.append(
+                    engine.deliver_proposal("s", payload.clone(), NOW + 20)
+                )
+            elif kind == "deliver_batch":
+                log.append(
+                    engine.deliver_proposals(
+                        [("s", payload.clone()), ("s", payload.clone())],
+                        NOW + 20,
+                    )
+                )
+            elif kind == "votes":
+                sub = engine.ingest_votes(
+                    [("s", v.clone()) for v in chain.votes[:payload]],
+                    NOW + 20,
+                )
+                log.append([int(s) for s in sub])
+        try:
+            session = engine.export_session("s", chain.proposal_id)
+            final = (
+                [v.vote_hash for v in session.proposal.votes],
+                sorted(session.votes),
+                session.state.kind,
+                session.state.result,
+            )
+        except Exception as exc:  # session never registered
+            final = repr(exc)
+        outcomes.append((log, final))
+    assert outcomes[0] == outcomes[1]
+
+
+@settings(max_examples=50, deadline=None)
+@given(
+    max_entries=st.integers(min_value=1, max_value=16),
+    use_byte_cap=st.booleans(),
+    keys=st.lists(
+        st.binary(min_size=1, max_size=48), min_size=1, max_size=80
+    ),
+)
+def test_eviction_bounds_hold(max_entries, use_byte_cap, keys):
+    max_bytes = (
+        max_entries * (24 + _ENTRY_OVERHEAD) if use_byte_cap else None
+    )
+    cache = VerifiedVoteCache(max_entries=max_entries, max_bytes=max_bytes)
+    for i, key in enumerate(keys):
+        cache.put(key, bool(i % 2))
+        assert len(cache) <= max_entries
+        if max_bytes is not None:
+            # A single oversized entry is allowed to stand alone; beyond
+            # that the byte cap holds.
+            assert cache.bytes_used <= max_bytes or len(cache) == 1
+    # Every retained entry still serves its verdict.
+    from hashgraph_tpu.engine.verify_cache import MISS
+
+    served = sum(1 for key in set(keys) if cache.get(key) is not MISS)
+    assert served == len(cache)

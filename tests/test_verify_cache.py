@@ -1,0 +1,339 @@
+"""VerifiedVoteCache: bounds, LRU policy, negative verdicts, and the
+engine integration (in-batch dedup, scalar-path consultation, poisoning
+resistance). Tier-1 fast — stub signatures only."""
+
+import threading
+
+import pytest
+
+from hashgraph_tpu import (
+    CreateProposalRequest,
+    StubConsensusSigner,
+    build_vote,
+)
+from hashgraph_tpu.engine import TpuConsensusEngine, VerifiedVoteCache
+from hashgraph_tpu.engine.verify_cache import MISS, _ENTRY_OVERHEAD
+from hashgraph_tpu.errors import ConsensusSchemeError, StatusCode
+
+from common import NOW
+
+OK = int(StatusCode.OK)
+
+
+class CountingSigner(StubConsensusSigner):
+    """Stub scheme that counts class-level verify calls (verify_batch
+    delegates to verify via the base-class default, so one counter covers
+    both entry points)."""
+
+    calls = 0
+
+    @classmethod
+    def verify(cls, identity, payload, signature):
+        cls.calls += 1
+        return super().verify(identity, payload, signature)
+
+
+@pytest.fixture(autouse=True)
+def _reset_counter():
+    CountingSigner.calls = 0
+
+
+def make_engine(cache="default", signer=None):
+    return TpuConsensusEngine(
+        signer if signer is not None else CountingSigner(b"\x77" * 20),
+        capacity=32,
+        voter_capacity=8,
+        verify_cache=cache,
+    )
+
+
+def make_proposal(engine, n=6, scope="s"):
+    return engine.create_proposal(
+        scope,
+        CreateProposalRequest(
+            name="p",
+            payload=b"x",
+            proposal_owner=b"o",
+            expected_voters_count=n,
+            expiration_timestamp=10_000,
+            liveness_criteria_yes=True,
+        ),
+        NOW,
+    )
+
+
+class TestCacheBounds:
+    def test_roundtrip_and_miss(self):
+        cache = VerifiedVoteCache(max_entries=4)
+        assert cache.get(b"k1") is MISS
+        cache.put(b"k1", True)
+        assert cache.get(b"k1") is True
+        err = ConsensusSchemeError.verify("bad")
+        cache.put(b"k2", err)
+        assert cache.get(b"k2") is err
+        cache.put(b"k3", False)
+        assert cache.get(b"k3") is False
+
+    def test_entry_cap_evicts_lru(self):
+        cache = VerifiedVoteCache(max_entries=3)
+        for k in (b"a", b"b", b"c"):
+            cache.put(k, True)
+        cache.get(b"a")  # refresh: "b" becomes the LRU victim
+        cache.put(b"d", True)
+        assert len(cache) == 3
+        assert cache.get(b"b") is MISS
+        assert cache.get(b"a") is True
+
+    def test_byte_cap_evicts(self):
+        per_entry = 8 + _ENTRY_OVERHEAD
+        cache = VerifiedVoteCache(max_entries=1000, max_bytes=3 * per_entry)
+        for i in range(10):
+            cache.put(b"key%05d" % i, True)
+        assert len(cache) <= 3
+        assert cache.bytes_used <= 3 * per_entry
+        # Newest survives.
+        assert cache.get(b"key00009" ) is True
+
+    def test_overwrite_does_not_leak_bytes(self):
+        cache = VerifiedVoteCache(max_entries=8)
+        for _ in range(100):
+            cache.put(b"same-key", True)
+        assert len(cache) == 1
+        assert cache.bytes_used == 8 + _ENTRY_OVERHEAD
+
+    def test_invalid_bounds_rejected(self):
+        with pytest.raises(ValueError):
+            VerifiedVoteCache(max_entries=0)
+        with pytest.raises(ValueError):
+            VerifiedVoteCache(max_bytes=0)
+
+    def test_clear_and_stats(self):
+        cache = VerifiedVoteCache(max_entries=8, max_bytes=10_000)
+        cache.put(b"k", True)
+        stats = cache.stats()
+        assert stats["entries"] == 1 and stats["max_bytes"] == 10_000
+        cache.clear()
+        assert len(cache) == 0 and cache.bytes_used == 0
+
+    def test_concurrent_put_get_stays_bounded(self):
+        cache = VerifiedVoteCache(max_entries=64)
+        errors = []
+
+        def worker(seed):
+            try:
+                for i in range(500):
+                    cache.put(b"%d-%d" % (seed, i % 100), bool(i % 2))
+                    cache.get(b"%d-%d" % ((seed + 1) % 4, i % 100))
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(s,)) for s in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len(cache) <= 64
+
+
+class TestEngineIntegration:
+    def test_redelivered_vote_verified_once(self):
+        engine = make_engine()
+        proposal = make_proposal(engine)
+        vote = build_vote(proposal, True, CountingSigner(b"\x01" * 20), NOW + 1)
+        CountingSigner.calls = 0
+        engine.process_incoming_vote("s", vote.clone(), NOW + 2)
+        assert CountingSigner.calls == 1
+        # Redelivery: admission is a cache hit; the duplicate rejection
+        # still fires, so statuses are unchanged from the uncached flow.
+        [code] = engine.ingest_votes([("s", vote.clone())], NOW + 3)
+        assert CountingSigner.calls == 1
+        assert int(code) != OK
+
+    def test_in_batch_dedup_single_verify(self):
+        engine = make_engine()
+        proposal = make_proposal(engine)
+        vote = build_vote(proposal, True, CountingSigner(b"\x01" * 20), NOW + 1)
+        CountingSigner.calls = 0
+        statuses = engine.ingest_votes(
+            [("s", vote.clone()) for _ in range(5)], NOW + 2
+        )
+        assert CountingSigner.calls == 1
+        # First instance applies, the rest are duplicates — same as uncached.
+        assert int(statuses[0]) == OK
+        assert all(int(s) != OK for s in statuses[1:])
+
+    def test_negative_verdict_cached(self):
+        engine = make_engine()
+        proposal = make_proposal(engine)
+        vote = build_vote(proposal, True, CountingSigner(b"\x01" * 20), NOW + 1)
+        vote.signature = b"\x00" * 32  # wrong, but structurally present
+        CountingSigner.calls = 0
+        for _ in range(3):
+            [code] = engine.ingest_votes([("s", vote.clone())], NOW + 2)
+            assert int(code) == int(StatusCode.INVALID_VOTE_SIGNATURE)
+        assert CountingSigner.calls == 1
+
+    def test_forged_signature_cannot_poison_good_vote(self):
+        engine = make_engine()
+        proposal = make_proposal(engine)
+        good = build_vote(proposal, True, CountingSigner(b"\x01" * 20), NOW + 1)
+        forged = good.clone()
+        forged.signature = b"\xff" * 32
+        [code] = engine.ingest_votes([("s", forged)], NOW + 2)
+        assert int(code) == int(StatusCode.INVALID_VOTE_SIGNATURE)
+        # The forged delivery must not have poisoned (or pre-seeded a
+        # rejection for) the honestly signed vote.
+        [code] = engine.ingest_votes([("s", good)], NOW + 2)
+        assert int(code) == OK
+
+    def test_tampered_hash_field_not_cached(self):
+        engine = make_engine()
+        proposal = make_proposal(engine)
+        vote = build_vote(proposal, True, CountingSigner(b"\x01" * 20), NOW + 1)
+        bad = vote.clone()
+        bad.vote_hash = b"\x01" * 32  # mismatched embedded hash
+        [code] = engine.ingest_votes([("s", bad)], NOW + 2)
+        assert int(code) == int(StatusCode.INVALID_VOTE_HASH)
+        assert len(engine.verify_cache()) == 0  # nothing cached for it
+        [code] = engine.ingest_votes([("s", vote.clone())], NOW + 2)
+        assert int(code) == OK
+
+    def test_unknown_string_sentinel_rejected(self):
+        with pytest.raises(ValueError):
+            make_engine("shared")  # BridgeServer's sentinel, not the engine's
+        from hashgraph_tpu.bridge.server import BridgeServer
+
+        with pytest.raises(ValueError):
+            BridgeServer(verify_cache="default")  # and vice versa
+
+    def test_cache_disabled_statuses_identical(self):
+        on, off = make_engine("default"), make_engine(None)
+        assert off.verify_cache() is None
+        votes_on, votes_off = [], []
+        for engine, out in ((on, votes_on), (off, votes_off)):
+            proposal = make_proposal(engine)
+            chain = proposal.clone()
+            for i in range(4):
+                signer = CountingSigner(bytes([i + 1]) * 20)
+                chain.votes.append(build_vote(chain, True, signer, NOW + i))
+            batch = [("s", v.clone()) for v in chain.votes]
+            # Deliver twice: growth then redelivery.
+            out.append([int(s) for s in engine.ingest_votes(batch, NOW + 9)])
+            out.append([int(s) for s in engine.ingest_votes(batch, NOW + 9)])
+        assert votes_on == votes_off
+
+    def test_shared_cache_across_engines(self):
+        shared = VerifiedVoteCache()
+        a = make_engine(shared)
+        b = make_engine(shared, signer=CountingSigner(b"\x78" * 20))
+        proposal = make_proposal(a)
+        wire = proposal.encode()
+        from hashgraph_tpu.wire import Proposal
+
+        b.process_incoming_proposal("s", Proposal.decode(wire), NOW)
+        vote = build_vote(proposal, True, CountingSigner(b"\x01" * 20), NOW + 1)
+        CountingSigner.calls = 0
+        a.process_incoming_vote("s", vote.clone(), NOW + 2)
+        b.process_incoming_vote("s", vote.clone(), NOW + 2)
+        # Second engine reuses the first's verdict: one process-wide verify.
+        assert CountingSigner.calls == 1
+
+    def test_shared_cache_isolates_schemes(self):
+        """Admission keys are scheme-tagged: one shared cache serving
+        engines with different signature schemes never cross-serves a
+        verdict (scheme A's True is not scheme B's)."""
+
+        class RejectingSigner(StubConsensusSigner):
+            @classmethod
+            def verify(cls, identity, payload, signature):
+                return False
+
+        shared = VerifiedVoteCache()
+        accepting = make_engine(shared)
+        rejecting = make_engine(shared, signer=RejectingSigner(b"\x79" * 20))
+        proposal = make_proposal(accepting)
+        from hashgraph_tpu.wire import Proposal
+
+        rejecting.process_incoming_proposal(
+            "s", Proposal.decode(proposal.encode()), NOW
+        )
+        vote = build_vote(proposal, True, CountingSigner(b"\x01" * 20), NOW + 1)
+        accepting.process_incoming_vote("s", vote.clone(), NOW + 2)
+        assert len(shared) >= 1  # verdict cached under the stub scheme tag
+        [code] = rejecting.ingest_votes([("s", vote.clone())], NOW + 2)
+        assert int(code) == int(StatusCode.INVALID_VOTE_SIGNATURE)
+
+    def test_expired_proposal_batch_buys_no_crypto(self):
+        """Redelivered EXPIRED chains are excluded from the batch verify
+        prepass — the same zero-crypto fail-fast the scalar path has."""
+        sender = make_engine()
+        proposal = make_proposal(sender, scope="src")
+        chain = proposal.clone()
+        for i in range(3):
+            signer = CountingSigner(bytes([i + 1]) * 20)
+            chain.votes.append(build_vote(chain, True, signer, NOW + i))
+        receiver = make_engine()
+        CountingSigner.calls = 0
+        late = proposal.expiration_timestamp + 1
+        statuses = receiver.ingest_proposals([("s", chain.clone())], late)
+        assert [int(s) for s in statuses] == [int(StatusCode.PROPOSAL_EXPIRED)]
+        assert CountingSigner.calls == 0
+        assert len(receiver.verify_cache()) == 0
+
+    def test_ingest_proposals_dedups_across_chains(self):
+        """The same signed votes appearing in many chains of one batch
+        collapse to one verify item each."""
+        sender = make_engine()
+        proposal = make_proposal(sender, scope="src")
+        chain = proposal.clone()
+        for i in range(3):
+            signer = CountingSigner(bytes([i + 1]) * 20)
+            chain.votes.append(build_vote(chain, True, signer, NOW + i))
+        receiver = make_engine()
+        # Two distinct scopes carry the identical chain: 3 unique votes.
+        CountingSigner.calls = 0
+        statuses = receiver.ingest_proposals(
+            [("a", chain.clone()), ("b", chain.clone())], NOW + 10
+        )
+        assert [int(s) for s in statuses] == [OK, OK]
+        assert CountingSigner.calls == 3
+
+    def test_redelivered_proposal_skips_all_verification(self):
+        receiver = make_engine()
+        sender = make_engine()
+        proposal = make_proposal(sender, scope="src")
+        chain = proposal.clone()
+        for i in range(3):
+            signer = CountingSigner(bytes([i + 1]) * 20)
+            chain.votes.append(build_vote(chain, True, signer, NOW + i))
+        assert [int(s) for s in receiver.ingest_proposals(
+            [("s", chain.clone())], NOW + 10
+        )] == [OK]
+        CountingSigner.calls = 0
+        # Redelivery of a registered pid: settled before any crypto.
+        statuses = receiver.ingest_proposals([("s", chain.clone())], NOW + 11)
+        assert [int(s) for s in statuses] == [
+            int(StatusCode.PROPOSAL_ALREADY_EXIST)
+        ]
+        assert CountingSigner.calls == 0
+
+    def test_metrics_families_exposed(self):
+        from hashgraph_tpu.obs import registry
+
+        engine = make_engine()
+        proposal = make_proposal(engine)
+        vote = build_vote(proposal, True, CountingSigner(b"\x01" * 20), NOW + 1)
+        engine.process_incoming_vote("s", vote.clone(), NOW + 2)
+        engine.ingest_votes([("s", vote.clone())], NOW + 3)
+        text = registry.render_prometheus()
+        for family in (
+            "hashgraph_verify_cache_hits_total",
+            "hashgraph_verify_cache_misses_total",
+            "hashgraph_verify_cache_negative_hits_total",
+            "hashgraph_verify_cache_evictions_total",
+            "hashgraph_chain_suffix_length",
+        ):
+            assert family in text, family
+        snap = registry.snapshot()
+        assert snap["counters"]["hashgraph_verify_cache_hits_total"] >= 1
