@@ -1213,8 +1213,22 @@ class TpuConsensusEngine(Generic[Scope]):
         round caps — mirror the per-vote gossip path this call amortizes:
         earlier suffix votes stay applied and the first hard code is
         returned, exactly the state feeding the suffix through
-        process_incoming_vote one by one would leave."""
+        process_incoming_vote one by one would leave. One documented
+        boundary divergence (PARITY.md): the expiry fail-fast below uses
+        the proposal-level ``now >= expiration`` check shared by every
+        proposal entry point, while the per-vote path expires strictly
+        after (``now > expiration``) — a delivery at exactly
+        ``now == expiration_timestamp`` is rejected here but would apply
+        through the per-vote fallback."""
         proposal = record.proposal
+        # Fail-fast BEFORE the signature prepass, matching the expiry
+        # guards in process_incoming_proposal / ingest_proposals: an
+        # attacker redelivering extensions of an expired session must not
+        # be able to buy ECDSA work or churn the shared cache's LRU.
+        try:
+            validate_proposal_timestamp(proposal.expiration_timestamp, now)
+        except ConsensusError as exc:
+            return int(exc.code)
         verdicts, hashes = self._cached_verify(suffix)
         for i, vote in enumerate(suffix):
             if vote.proposal_id != proposal.proposal_id:
@@ -1411,12 +1425,15 @@ class TpuConsensusEngine(Generic[Scope]):
         both into validate_vote so the SHA pass here is the only one.
 
         With the cache disabled this is a plain batched verify (identical
-        to the pre-cache flow). Rows whose embedded ``vote_hash`` field
-        does not match the recomputed digest — or with structurally empty
-        owner/hash/signature — are neither verified nor cached: their
-        admission key would not determine the signing payload (see the
-        verify_cache module docstring), and validate_vote rejects them
-        before ever consulting the signature verdict."""
+        to the pre-cache flow). Admission keys are derived from each
+        vote's ``signing_payload()`` — the exact bytes the scheme
+        verifies — so a key can never be shared by two different
+        verification questions (see the verify_cache module docstring).
+        Rows whose embedded ``vote_hash`` field does not match the
+        recomputed digest — or with structurally empty
+        owner/hash/signature — are neither verified nor cached:
+        validate_vote rejects them before ever consulting the signature
+        verdict, so caching them would only churn the LRU."""
         hashes = [compute_vote_hash(v) for v in votes]
         if self._verify_cache is None:
             if not votes:
@@ -1437,6 +1454,7 @@ class TpuConsensusEngine(Generic[Scope]):
         verdicts: list = [False] * len(votes)
         rows: list[int] = []
         keys: list[bytes] = []
+        payloads: list[bytes] = []
         for i, (vote, digest) in enumerate(zip(votes, hashes)):
             if (
                 not vote.vote_owner
@@ -1444,18 +1462,24 @@ class TpuConsensusEngine(Generic[Scope]):
                 or vote.vote_hash != digest
             ):
                 continue  # verdict unreachable in validate_vote's ordering
+            payload = vote.signing_payload()
             rows.append(i)
+            payloads.append(payload)
             keys.append(
                 VerifiedVoteCache.key(
-                    digest, vote.signature, self._verify_scheme_tag
+                    payload, vote.signature, self._verify_scheme_tag
                 )
             )
         miss_rows: dict[bytes, list[int]] = {}
-        for i, key, hit in zip(rows, keys, cache.get_many(keys)):
+        miss_payloads: dict[bytes, bytes] = {}
+        for i, key, payload, hit in zip(
+            rows, keys, payloads, cache.get_many(keys)
+        ):
             if hit is not MISS:
                 verdicts[i] = hit
             else:
                 miss_rows.setdefault(key, []).append(i)
+                miss_payloads.setdefault(key, payload)
         if miss_rows:
             rep = [rows[0] for rows in miss_rows.values()]
             with observed_span(
@@ -1466,7 +1490,7 @@ class TpuConsensusEngine(Generic[Scope]):
             ):
                 fresh = self._scheme.verify_batch(
                     [votes[i].vote_owner for i in rep],
-                    [votes[i].signing_payload() for i in rep],
+                    list(miss_payloads.values()),
                     [votes[i].signature for i in rep],
                 )
             for (_, miss), verdict in zip(miss_rows.items(), fresh):

@@ -12,16 +12,19 @@ chain. This module memoizes the *signature verdict* per unique
 
 What is cached — and why it is safe:
 
-- The key is ``compute_vote_hash(vote) + vote.signature``. The computed
-  hash covers every signed field except the signature and the embedded
-  ``vote_hash`` field itself; ``validate_vote`` checks
-  ``vote.vote_hash == computed`` *before* consulting the signature
-  verdict, so at every consultation point the key fully determines the
-  signing payload. A forged signature therefore lives under its own key
-  and can never poison (or be served) the verdict of the honestly signed
-  vote. Callers must only consult/populate the cache for votes whose
-  embedded hash matches the recomputed one (the engine's
-  ``_cached_verify`` enforces this).
+- The key is a SHA-256 over the length-framed triple (scheme tag,
+  ``vote.signing_payload()``, signature) — see :meth:`VerifiedVoteCache.key`.
+  ``signing_payload()`` is the exact byte string handed to
+  ``scheme.verify``, so the key uniquely determines the (signer, message,
+  signature) question whose answer it stores; a forged signature lives
+  under its own key and can never poison (or be served) the verdict of
+  the honestly signed vote. ``compute_vote_hash`` deliberately is NOT
+  the key: it concatenates the variable-length
+  ``vote_owner``/``parent_hash``/``received_hash`` fields without length
+  framing, so two votes with *different* signing payloads (e.g. bytes
+  shifted between ``parent_hash`` and ``received_hash``) can share a
+  vote hash — keying on it would let a crafted never-signed vote be
+  served the honest vote's cached ``True``.
 - The value is exactly what ``ConsensusSignatureScheme.verify_batch``
   yields per item: ``True``, ``False``, or the ``ConsensusSchemeError``
   that scalar ``verify`` would have raised. Negative verdicts are cached
@@ -44,6 +47,7 @@ registry (:mod:`hashgraph_tpu.obs`) and appear in ``/metrics``.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from collections import OrderedDict
 
@@ -98,18 +102,28 @@ class VerifiedVoteCache:
 
     @staticmethod
     def key(
-        computed_hash: bytes, signature: bytes, scheme_tag: bytes = b""
+        signing_payload: bytes, signature: bytes, scheme_tag: bytes = b""
     ) -> bytes:
-        """Admission key for one vote. ``computed_hash`` MUST be
-        ``protocol.compute_vote_hash(vote)`` and the caller must have
-        checked ``vote.vote_hash == computed_hash`` (see module
-        docstring) — an unchecked embedded hash would let a mismatched
-        payload share a key with the canonical one. ``scheme_tag``
-        namespaces verdicts by signature-scheme identity (the engine
-        derives it from its scheme type): one cache instance shared by
-        engines with DIFFERENT schemes must never serve scheme A's
-        verdict for scheme B's verification of the same bytes."""
-        return scheme_tag + computed_hash + signature
+        """Admission key for one vote: SHA-256 over the length-framed
+        (scheme_tag, signing_payload) pair plus the signature.
+        ``signing_payload`` MUST be ``vote.signing_payload()`` — the
+        exact bytes the scheme verifies — so the key unambiguously
+        determines the verification question (see module docstring for
+        why ``compute_vote_hash`` is NOT a safe substitute). Each
+        variable-length component is length-prefixed; the signature is
+        terminal so it needs no frame. ``scheme_tag`` namespaces
+        verdicts by signature-scheme identity (the engine derives it
+        from its scheme type): one cache instance shared by engines with
+        DIFFERENT schemes must never serve scheme A's verdict for scheme
+        B's verification of the same bytes. The digest form also keeps
+        every entry's key at a flat 32 bytes."""
+        h = hashlib.sha256()
+        h.update(len(scheme_tag).to_bytes(4, "little"))
+        h.update(scheme_tag)
+        h.update(len(signing_payload).to_bytes(4, "little"))
+        h.update(signing_payload)
+        h.update(signature)
+        return h.digest()
 
     def get(self, key: bytes):
         """Cached verdict for ``key``, or :data:`MISS`. A hit refreshes

@@ -104,6 +104,40 @@ class TestDeliverProposals:
         )
         assert len(receiver.get_proposal("s", chain.proposal_id).votes) == 4
 
+    def test_expired_extension_fails_fast_without_crypto(self):
+        """Extensions of an expired session are rejected BEFORE the
+        signature prepass, matching the expiry fail-fasts on the
+        process_incoming_proposal / ingest_proposals entry points: an
+        attacker redelivering grown chains of a dead session must not buy
+        ECDSA work or churn the shared cache's LRU."""
+
+        class CountingSigner(StubConsensusSigner):
+            calls = 0
+
+            @classmethod
+            def verify(cls, identity, payload, signature):
+                cls.calls += 1
+                return super().verify(identity, payload, signature)
+
+        engine = TpuConsensusEngine(
+            CountingSigner(b"\x42" * 20),
+            capacity=32,
+            voter_capacity=16,
+            verify_cache="default",
+        )
+        _, _, chain = make_chain(engine=engine)
+        assert engine.deliver_proposal("s", grown(chain, 3), NOW + 20) == OK
+        cached = len(engine.verify_cache())
+        CountingSigner.calls = 0
+        expiry = engine.get_proposal("s", chain.proposal_id).expiration_timestamp
+        late = expiry + 1
+        [code] = engine.deliver_proposals([("s", grown(chain, 6))], late)
+        assert code == int(StatusCode.PROPOSAL_EXPIRED)
+        assert CountingSigner.calls == 0
+        assert len(engine.verify_cache()) == cached
+        # The accepted prefix is untouched.
+        assert len(engine.get_proposal("s", chain.proposal_id).votes) == 3
+
     def test_fork_before_watermark_rejected(self):
         _, proposal, chain = make_chain()
         receiver = make_engine()

@@ -136,6 +136,24 @@ class TestCacheBounds:
         assert len(cache) <= 64
 
 
+class TestAdmissionKey:
+    def test_key_is_fixed_size_framed_digest(self):
+        k = VerifiedVoteCache.key(b"payload", b"sig", b"tag")
+        assert len(k) == 32  # digest form: flat key size regardless of inputs
+        assert k == VerifiedVoteCache.key(b"payload", b"sig", b"tag")
+        assert k != VerifiedVoteCache.key(b"payload", b"sig", b"other")
+        assert k != VerifiedVoteCache.key(b"other", b"sig", b"tag")
+        assert k != VerifiedVoteCache.key(b"payload", b"other", b"tag")
+        # Length framing: shifting bytes across a component boundary must
+        # change the key — plain concatenation would not.
+        assert VerifiedVoteCache.key(b"b", b"", b"a") != VerifiedVoteCache.key(
+            b"ab", b"", b""
+        )
+        assert VerifiedVoteCache.key(b"a", b"b", b"") != VerifiedVoteCache.key(
+            b"", b"ab", b""
+        )
+
+
 class TestEngineIntegration:
     def test_redelivered_vote_verified_once(self):
         engine = make_engine()
@@ -186,6 +204,39 @@ class TestEngineIntegration:
         # rejection for) the honestly signed vote.
         [code] = engine.ingest_votes([("s", good)], NOW + 2)
         assert int(code) == OK
+
+    def test_collision_twin_cannot_inherit_cached_verdict(self):
+        """compute_vote_hash concatenates parent_hash/received_hash with
+        no length framing, so swapping bytes between those fields yields
+        a DIFFERENT signing payload with the SAME vote hash. The
+        admission key is a digest of the signed bytes, so the
+        never-signed twin is a cache miss and is rejected exactly as the
+        uncached scheme.verify would — a (vote_hash, signature) key
+        would have served it the honest vote's cached True, admitting
+        forged chain-linkage fields."""
+        from hashgraph_tpu.protocol import compute_vote_hash
+
+        engine = make_engine()
+        proposal = make_proposal(engine)
+        first = build_vote(proposal, True, CountingSigner(b"\x01" * 20), NOW + 1)
+        chain = proposal.clone()
+        chain.votes.append(first.clone())
+        honest = build_vote(chain, True, CountingSigner(b"\x02" * 20), NOW + 2)
+        # Exactly one of the two adjacent chain-link fields is non-empty:
+        # the unframed concatenation cannot tell which side owns the bytes.
+        assert honest.parent_hash == b""
+        assert honest.received_hash == first.vote_hash
+        crafted = honest.clone()
+        crafted.parent_hash = honest.received_hash
+        crafted.received_hash = honest.parent_hash
+        assert compute_vote_hash(crafted) == compute_vote_hash(honest)
+        assert crafted.signing_payload() != honest.signing_payload()
+        statuses = engine.ingest_votes(
+            [("s", first.clone()), ("s", honest.clone())], NOW + 3
+        )
+        assert [int(s) for s in statuses] == [OK, OK]  # honest verdict cached
+        [code] = engine.ingest_votes([("s", crafted)], NOW + 3)
+        assert int(code) == int(StatusCode.INVALID_VOTE_SIGNATURE)
 
     def test_tampered_hash_field_not_cached(self):
         engine = make_engine()
