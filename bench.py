@@ -38,6 +38,10 @@ histograms can be scraped live while the bench executes. ``--trace-out
 PATH`` runs the bench under one distributed trace context and exports the
 context-tagged spans (device ingest, verify batches, WAL fsyncs, per-
 proposal lifecycles) as a Chrome trace-event file for Perfetto.
+``--health-out PATH`` writes the consensus-health snapshot (peer
+scorecards with grades, equivocation/fork evidence, watchdog, firing
+alert rules — :mod:`hashgraph_tpu.obs.health`) to PATH and folds the
+alert counts into the emitted JSON under ``health``.
 
 Traces are pre-validated replays (signature/hash verification is the
 pluggable host stage — measured separately by ``python bench.py crypto``
@@ -1510,6 +1514,13 @@ if __name__ == "__main__":
 
     metrics_out = _pop_flag("--metrics-out")
 
+    # --health-out PATH: after the run, snapshot the process-wide health
+    # monitor (peer scorecards with grades, equivocation/fork evidence,
+    # watchdog state, firing alert rules) to PATH, and fold the alert
+    # counts into the BENCH json line — a bench run that tripped an
+    # anomaly rule should say so in the artifact, not just in a side file.
+    health_out = _pop_flag("--health-out")
+
     # --compile-cache DIR: enable JAX's persistent compilation cache so a
     # re-run at the same geometry skips XLA compiles (BENCH_r05 measured
     # 147.7 s of compile warmup in engine_config4 alone). Thresholds are
@@ -1591,6 +1602,25 @@ if __name__ == "__main__":
 
         return registry.snapshot()
 
+    def _health_snapshot() -> dict:
+        from hashgraph_tpu.obs import health_monitor
+
+        return health_monitor.snapshot()
+
+    def _health_summary(snap: dict) -> dict:
+        """Compact alert view for the BENCH json line (the full
+        scorecard/evidence snapshot lives in --health-out's file)."""
+        firing = snap["alerts"]["firing"]
+        grades: dict[str, int] = {}
+        for card in snap["peers"].values():
+            grades[card["grade"]] = grades.get(card["grade"], 0) + 1
+        return {
+            "alert_events_total": snap["alerts"]["events_total"],
+            "alerts_firing": [a["rule"] for a in firing],
+            "evidence_records": len(snap["evidence"]),
+            "peer_grades": grades,
+        }
+
     # finally: a run that RAISES is exactly the one whose trace matters —
     # the export (and sidecar shutdown) must survive runner failures.
     try:
@@ -1617,8 +1647,18 @@ if __name__ == "__main__":
                     json.dump(
                         {"results": results, "metrics": _registry_snapshot()}, fh
                     )
+            if health_out is not None:
+                snap = _health_snapshot()
+                with open(health_out, "w") as fh:
+                    json.dump(snap, fh)
+                print(json.dumps({"health": _health_summary(snap)}))
         else:
             result = runners[which]()
+            if health_out is not None:
+                snap = _health_snapshot()
+                with open(health_out, "w") as fh:
+                    json.dump(snap, fh)
+                result["health"] = _health_summary(snap)
             if metrics_out is not None:
                 result["metrics"] = _registry_snapshot()
                 with open(metrics_out, "w") as fh:

@@ -37,6 +37,18 @@ REQUIRED_FAMILIES = [
     "bridge_requests_total",
     # Labelled info gauge: who/what is serving this scrape.
     "hashgraph_build_info{",
+    # Consensus-health observatory families.
+    "hashgraph_alerts_total",
+    "hashgraph_equivocations_total",
+    "hashgraph_fork_redeliveries_total",
+    "hashgraph_tracked_peers",
+    "hashgraph_stale_peers",
+    "hashgraph_evidence_records",
+    # Device/XLA telemetry: live buffer bytes sampled at scrape time,
+    # persistent-compile-cache traffic via jax.monitoring events.
+    "hashgraph_jax_live_buffer_bytes",
+    "hashgraph_jax_compile_cache_hits_total",
+    "hashgraph_jax_compile_cache_misses_total",
 ]
 
 
@@ -80,11 +92,33 @@ def main() -> int:
                 # label must name a real runtime, not a placeholder.
                 assert 'backend="not-loaded"' not in build_line, build_line
 
+                # The bridge ran real device ingest: the live-buffer
+                # gauge must report actual resident bytes, not a dead 0.
+                buffer_line = next(
+                    l for l in text.splitlines()
+                    if l.startswith("hashgraph_jax_live_buffer_bytes ")
+                )
+                assert float(buffer_line.split()[-1]) > 0, buffer_line
+
                 with urllib.request.urlopen(
                     f"http://{mhost}:{mport}/healthz", timeout=5
                 ) as response:
                     health = json.loads(response.read())
                 assert health["ok"] and health["peers"] == 2, health
+                # Enriched /healthz: the alerts array is always present
+                # (machine-readable degradation reasons appear there and
+                # in "reasons" when a critical rule fires).
+                assert "alerts" in health, health
+
+                # Consensus-health snapshot over the wire (OP_HEALTH):
+                # both voters carry healthy scorecards.
+                report = alice.health(a_peer, NOW + 1)
+                assert report["wal"]["fsync_policy"] == "always", report["wal"]
+                grades = {
+                    card["grade"] for card in report["peers"].values()
+                }
+                assert grades == {"healthy"}, report["peers"]
+                assert report["alerts"]["firing"] == [], report["alerts"]
 
                 # Same families over the bridge wire (GET_METRICS opcode).
                 wire_text = alice.get_metrics()

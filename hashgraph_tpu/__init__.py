@@ -72,6 +72,8 @@ from .types import (
 )
 from .obs import FlightRecorder, MetricsRegistry, MetricsSidecar
 from .obs import flight_recorder, registry as metrics_registry
+from .obs.health import AlertRule, EvidenceRecord, HealthMonitor
+from .obs import health_monitor
 from .obs.trace import TraceContext, trace_store
 from .wal import DurableEngine, WalWriter
 from .wire import Proposal, Vote
@@ -87,6 +89,10 @@ __all__ = [
     "MetricsSidecar",
     "FlightRecorder",
     "TraceContext",
+    "AlertRule",
+    "EvidenceRecord",
+    "HealthMonitor",
+    "health_monitor",
     "metrics_registry",
     "flight_recorder",
     "trace_store",

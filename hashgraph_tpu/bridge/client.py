@@ -284,6 +284,19 @@ class BridgeClient:
         )
         return json.loads(cursor.blob().decode("utf-8"))
 
+    def health(self, peer: int, now: int | None = None) -> dict:
+        """Consensus-health snapshot for one peer (``OP_HEALTH``):
+        per-peer scorecards with derived ``healthy | suspect | faulty``
+        grades, the retained self-authenticating equivocation/fork
+        evidence (verbatim signed vote bytes, hex), liveness-watchdog
+        state, and the firing alert rules — plus the WAL watermark for
+        durable peers. ``now`` is the embedder's logical tick for
+        staleness grading (omit to use the server monitor's latest)."""
+        cursor = self._call(
+            P.OP_HEALTH, P.u32(peer) + P.u64(now if now is not None else 0)
+        )
+        return json.loads(cursor.blob().decode("utf-8"))
+
     def get_metrics(self) -> str:
         """Prometheus text-format scrape of the server process's metrics
         registry (server-wide — no peer id). The same text the HTTP

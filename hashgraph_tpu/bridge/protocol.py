@@ -71,6 +71,12 @@ OP_GET_METRICS = 12
 # one JSON blob (TpuConsensusEngine.explain_decision: vote chain, quorum
 # arithmetic, timeline phases, trace identity, WAL watermark).
 OP_EXPLAIN = 13
+# Consensus health observatory: u32 peer_id + u64 now (0 = the monitor's
+# latest observed logical tick) -> one JSON blob
+# (TpuConsensusEngine.health_report: per-peer scorecards with derived
+# grades, self-authenticating equivocation/fork evidence, liveness
+# watchdog, firing alert rules; durable peers overlay the WAL watermark).
+OP_HEALTH = 14
 
 # Bridge-level statuses (protocol StatusCode values occupy 0..29).
 STATUS_OK = 0

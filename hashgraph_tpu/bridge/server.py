@@ -29,6 +29,7 @@ from ..events import BroadcastEventBus, EventReceiver
 from ..obs import (
     BRIDGE_ERRORS_TOTAL,
     BRIDGE_REQUESTS_TOTAL,
+    HealthMonitor,
     MetricsSidecar,
     flight_recorder,
 )
@@ -95,6 +96,12 @@ class BridgeServer:
     engine ONE :class:`~hashgraph_tpu.engine.VerifiedVoteCache`, so a vote
     gossiped to N co-hosted peers is signature-verified once per process;
     its hit/miss/evict counters land on the registry above.
+
+    ``health_monitor`` (default: one fresh
+    :class:`~hashgraph_tpu.obs.HealthMonitor` per server) collects every
+    default-built peer engine's scorecards/evidence/alerts; firing
+    critical rules flip ``/healthz`` to 503 and the ``OP_HEALTH`` opcode
+    serves the full snapshot (``BridgeClient.health``).
     """
 
     def __init__(
@@ -110,6 +117,7 @@ class BridgeServer:
         metrics_port: int | None = None,
         metrics_host: str = "127.0.0.1",
         verify_cache: "VerifiedVoteCache | None | str" = "shared",
+        health_monitor: "HealthMonitor | None" = None,
     ):
         self._host = host
         self._port = port
@@ -131,6 +139,22 @@ class BridgeServer:
         self._verify_cache = (
             VerifiedVoteCache() if verify_cache == "shared" else verify_cache
         )
+        # ONE health monitor for every default-built peer engine: the
+        # scorecards, evidence log, and /healthz verdict describe THIS
+        # server's peers, not whatever other engines share the process
+        # (the engine's process-wide default monitor would bleed an
+        # unrelated engine's faulty peer into this server's 503). Anomaly
+        # counters still land on the process-wide registry. Engines from
+        # ``engine_factory`` keep whatever monitor they were built with.
+        # Gauges are registered only for a monitor this server built —
+        # a caller-passed monitor owns its own registration (it may
+        # already be registered; providers are additive, so a second
+        # registration would double its gauge contributions).
+        if health_monitor is not None:
+            self._health_monitor = health_monitor
+        else:
+            self._health_monitor = HealthMonitor(registry=default_registry)
+            self._health_monitor.register_gauges(default_registry)
         # Durability: with a wal_dir every peer's engine is wrapped in a
         # DurableEngine logging each incoming wire message BEFORE its ack
         # frame is sent (the response is only written after the handler —
@@ -183,9 +207,50 @@ class BridgeServer:
         return self._sidecar.address
 
     def _health(self) -> dict:
+        """``/healthz`` body: liveness plus the consensus-health verdict.
+        Every distinct health monitor behind the peer engines (one, when
+        the default process-wide monitor is shared; several, when an
+        engine_factory supplies private ones) is evaluated; firing
+        CRITICAL rules — signed misbehavior like an equivocating peer —
+        flip ``ok`` to false, which the sidecar serves as 503, with the
+        machine-readable reasons alongside so the balancer's operator
+        sees *why* without a second query. Warnings ride along in
+        ``alerts`` without degrading."""
         with self._lock:
             peers = len(self._peers)
-        return {"ok": self._running, "peers": peers}
+            # The server's own monitor always participates (it exists
+            # before the first ADD_PEER); engine_factory-built engines
+            # may carry different monitors — aggregate the distinct set.
+            monitors = {id(self._health_monitor): self._health_monitor}
+            for peer in self._peers.values():
+                monitor = getattr(peer.engine, "health", None)
+                if monitor is not None:
+                    monitors[id(monitor)] = monitor
+        alerts: list[dict] = []
+        for monitor in monitors.values():
+            try:
+                alerts.extend(monitor.evaluate_alerts())
+            except Exception:
+                # A broken rule must degrade the report, not the scrape.
+                continue
+        reasons = [
+            {
+                "rule": alert["rule"],
+                "severity": alert["severity"],
+                "description": alert.get("description", ""),
+                "details": alert.get("details", []),
+            }
+            for alert in alerts
+            if alert.get("severity") == "critical"
+        ]
+        out = {
+            "ok": self._running and not reasons,
+            "peers": peers,
+            "alerts": alerts,
+        }
+        if reasons:
+            out["reasons"] = reasons
+        return out
 
     def start(self) -> tuple[str, int]:
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -411,6 +476,7 @@ class BridgeServer:
             capacity=self._capacity,
             voter_capacity=self._voter_capacity,
             verify_cache=self._verify_cache,
+            health_monitor=self._health_monitor,
         )
 
     def _durable_engine(self, signer, identity: bytes):
@@ -628,6 +694,17 @@ class BridgeServer:
             + P.u32(stats.consensus_reached)
         )
 
+    def _op_health(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        """Consensus-health snapshot as one JSON blob (see
+        ``TpuConsensusEngine.health_report``): scorecards, evidence,
+        watchdog, firing alerts; durable peers overlay their WAL
+        watermark. The trailing u64 is the embedder's logical tick (0 =
+        use the monitor's latest — remote dashboards have no embedder
+        clock)."""
+        now = c.u64()
+        report = peer.engine.health_report(now if now else None)
+        return P.STATUS_OK, P.blob(json.dumps(report).encode("utf-8"))
+
     def _op_explain(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
         """Decision provenance as one JSON blob (see
         ``TpuConsensusEngine.explain_decision``); durable peers overlay
@@ -651,4 +728,5 @@ _HANDLERS = {
     P.OP_GET_PROPOSAL: BridgeServer._op_get_proposal,
     P.OP_GET_STATS: BridgeServer._op_get_stats,
     P.OP_EXPLAIN: BridgeServer._op_explain,
+    P.OP_HEALTH: BridgeServer._op_health,
 }

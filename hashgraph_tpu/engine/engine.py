@@ -59,7 +59,10 @@ from ..obs import (
     flight_recorder,
     observed_span,
 )
+from ..obs import health_monitor as default_health_monitor
+from ..obs import install_jax_telemetry
 from ..obs import registry as default_registry
+from ..obs.health import HealthMonitor
 from ..obs.registry import Counter
 from ..obs.timeline import OUTCOME_FAILED, OUTCOME_NO, OUTCOME_YES
 from ..obs.trace import TraceContext, current_context, trace_store
@@ -262,8 +265,19 @@ class TpuConsensusEngine(Generic[Scope]):
         max_sessions_per_scope: int = DEFAULT_MAX_SESSIONS_PER_SCOPE,
         pool: ProposalPool | None = None,
         verify_cache: "VerifiedVoteCache | None | str" = "default",
+        health_monitor: "HealthMonitor | None" = None,
     ):
         self._signer = signer
+        # Per-peer health accounting (scorecards, equivocation/fork
+        # evidence, liveness watchdog — obs.health). Engines default to
+        # the process-wide monitor so co-hosted peers accumulate one
+        # fleet view; pass a private HealthMonitor for isolation. Gated
+        # off during WAL replay (_health_live): replayed anomalies were
+        # recorded before the crash and must not double-count.
+        self.health: HealthMonitor = (
+            health_monitor if health_monitor is not None else default_health_monitor
+        )
+        self._health_live = True
         # Memoized vote-admission verdicts (verify each unique vote once —
         # the redelivery/incremental-chain amortization, see verify_cache
         # module docstring). "default" builds a per-engine cache; pass a
@@ -371,6 +385,11 @@ class TpuConsensusEngine(Generic[Scope]):
         self.metrics.register_gauge(
             VOTE_TABLE_OCCUPANCY, _pool_occupancy, owner=self
         )
+        # Device/XLA telemetry (live-buffer gauge provider is global;
+        # this routes the persistent-compile-cache monitoring events onto
+        # the registry). Idempotent, and this module already imports JAX
+        # through the pool, so obs itself stays jax-free.
+        install_jax_telemetry()
         # One engine-wide reentrant lock: the reference service is fully
         # thread-safe (whole-map RwLocks, src/storage.rs:192-193); the pool's
         # host mirrors and free lists need the same discipline. Coarse
@@ -410,6 +429,11 @@ class TpuConsensusEngine(Generic[Scope]):
         decisions. Vote/proposal counters keep counting: they measure work
         this process performed, and replay IS work."""
         self._timelines.replay_mode = on
+        # Health accounting pauses with replay for the same reason:
+        # replayed equivocations/forks were evidenced before the crash;
+        # re-recording them would double-count scorecards (evidence
+        # itself dedups, but counters do not).
+        self._health_live = not on
         if on:
             # Throwaway instruments: the ingest paths inc attributes
             # unconditionally, so swapping the targets is cheaper (and
@@ -872,7 +896,11 @@ class TpuConsensusEngine(Generic[Scope]):
         # re-runs the same check first, so error precedence is unchanged —
         # an attacker redelivering expired chains must not be able to buy
         # ECDSA work or churn the shared cache's LRU).
-        validate_proposal_timestamp(proposal.expiration_timestamp, now)
+        try:
+            validate_proposal_timestamp(proposal.expiration_timestamp, now)
+        except ConsensusError:
+            self._note_expired_proposal(proposal, now)
+            raise
         # Admission cache for the embedded chain: verdicts for known votes
         # come from the cache, the rest from one batched verify (None
         # disables the prepass entirely — from_proposal then verifies each
@@ -902,6 +930,7 @@ class TpuConsensusEngine(Generic[Scope]):
                 ),
             )
         self._register_session(scope, session, now)
+        self._note_chain_admitted(proposal.votes, config, now)
         if trace_store.enabled:
             slot = self._index.get((scope, proposal.proposal_id))
             if slot is not None:
@@ -914,6 +943,36 @@ class TpuConsensusEngine(Generic[Scope]):
                     scope,
                     wall0,
                 )
+
+    def _note_chain_admitted(
+        self, votes: "list[Vote]", config: ConsensusConfig, now: int
+    ) -> None:
+        """Scorecard admissions for an embedded chain accepted whole
+        (process_incoming_proposal / ingest_proposals): one dict pass per
+        chain, one monitor call — O(L) dict stores against the O(L)
+        SHA/ECDSA the chain already cost."""
+        if not self._health_live or not votes:
+            return
+        counts: dict[bytes, int] = {}
+        for vote in votes:
+            counts[vote.vote_owner] = counts.get(vote.vote_owner, 0) + 1
+        self.health.note_admitted(
+            counts, now, timeout_hint=config.consensus_timeout
+        )
+
+    def _note_expired_proposal(self, proposal: Proposal, now: int) -> None:
+        """Expired-gossip scorecard hit for a whole stale proposal,
+        attributed to the chain's most recent signer (falling back to the
+        proposal owner for vote-free proposals)."""
+        if not self._health_live:
+            return
+        source = (
+            proposal.votes[-1].vote_owner
+            if proposal.votes
+            else proposal.proposal_owner
+        )
+        if source:
+            self.health.note_expired(source, now)
 
     def ingest_proposals(
         self,
@@ -1052,8 +1111,11 @@ class TpuConsensusEngine(Generic[Scope]):
                         ),
                     )
                 self._register_session(scope, session, now)
+                self._note_chain_admitted(proposal.votes, config, now)
             except ConsensusError as exc:
                 statuses[i] = int(exc.code)
+                if exc.code == StatusCode.PROPOSAL_EXPIRED:
+                    self._note_expired_proposal(proposal, now)
         return statuses
 
     # ── Gossip delivery: create-or-extend (chain-prefix watermark) ─────
@@ -1174,13 +1236,76 @@ class TpuConsensusEngine(Generic[Scope]):
                 statuses[k] = int(StatusCode.SESSION_NOT_FOUND)
                 continue
             suffix = self._extension_suffix(record, proposal)
-            statuses[k] = (
-                self._apply_chain_suffix(record, suffix, now)
-                if suffix
-                else int(StatusCode.PROPOSAL_ALREADY_EXIST)
-            )
+            if suffix:
+                statuses[k] = self._apply_chain_suffix(record, suffix, now)
+            else:
+                statuses[k] = int(StatusCode.PROPOSAL_ALREADY_EXIST)
+                # The settle is still crypto-free; the health probe only
+                # re-walks the already-compared prefix to classify WHY the
+                # redelivery failed to extend (fork evidence / truncation
+                # lag) instead of discarding the signal.
+                self._note_redelivery_health(record, proposal, now)
         flush_run()
         return statuses
+
+    def _note_redelivery_health(
+        self, record: SessionRecord[Scope], proposal: Proposal, now: int
+    ) -> None:
+        """Classify a non-extending redelivery for the health layer. A
+        prefix mismatch before the validated watermark is a FORK: the
+        accepted vote and the divergent incoming vote at that position are
+        retained as a self-authenticating evidence pair, attributed to the
+        divergent vote's signer (its signature is NOT verified here — the
+        watermark path settles forks crypto-free; the bytes authenticate
+        themselves offline). A matching-but-shorter chain is a TRUNCATION:
+        the chain's most recent signer — the closest accountable identity
+        to the gossip source — is scored with the lag. Identical
+        redeliveries are benign and score nothing. Columnar-retained
+        sessions are skipped (merged order is not positionally
+        comparable, same reason _extension_suffix bails)."""
+        if not self._health_live or record.retained_wire:
+            return
+        accepted = record.proposal.votes
+        incoming = proposal.votes
+        n = len(incoming)
+        if n and n <= len(accepted):
+            # Benign fast path — identical redelivery (equal length) or a
+            # lagging peer (shorter): ONE tail-hash compare, no prefix
+            # walk, so the steady-state gossip settle stays O(1). The
+            # accepted chain's received_hash links commit each vote to
+            # its predecessor, so a matching tail at the same position
+            # means a matching prefix for fully-linked chains; chains
+            # with empty links could in principle share the tail while
+            # diverging earlier — evidence capture is best-effort there
+            # (the API status is PROPOSAL_ALREADY_EXIST either way).
+            if incoming[-1].vote_hash == accepted[n - 1].vote_hash:
+                if n < len(accepted):
+                    self.health.note_truncation(
+                        incoming[-1].vote_owner, len(accepted) - n, now
+                    )
+                return
+        elif not n:
+            if accepted and proposal.proposal_owner:
+                self.health.note_truncation(
+                    proposal.proposal_owner, len(accepted), now
+                )
+            return
+        # Mismatch guaranteed somewhere in the shared prefix (a strict
+        # extension would have taken the watermark path; a shorter/equal
+        # chain with an agreeing prefix matched its tail above — its
+        # differing vote at any position, tail included, IS a divergent
+        # history): find the fork position, retain the signed pair.
+        for ours, theirs in zip(accepted, incoming):
+            if ours.vote_hash != theirs.vote_hash:
+                self.health.note_fork(
+                    record.scope,
+                    proposal.proposal_id,
+                    ours.encode(),
+                    theirs.encode(),
+                    theirs.vote_owner,
+                    now,
+                )
+                return
 
     def _extension_suffix(
         self, record: SessionRecord[Scope], proposal: Proposal
@@ -1228,6 +1353,11 @@ class TpuConsensusEngine(Generic[Scope]):
         try:
             validate_proposal_timestamp(proposal.expiration_timestamp, now)
         except ConsensusError as exc:
+            if self._health_live and suffix[-1].vote_owner:
+                # Expired-gossip scorecard hit on the chain's most recent
+                # signer (the closest accountable identity to the
+                # redelivery source) — still zero crypto.
+                self.health.note_expired(suffix[-1].vote_owner, now)
             return int(exc.code)
         verdicts, hashes = self._cached_verify(suffix)
         for i, vote in enumerate(suffix):
@@ -1244,6 +1374,7 @@ class TpuConsensusEngine(Generic[Scope]):
                     computed_hash=hashes[i],
                 )
             except ConsensusError as exc:
+                self._note_reject_health(vote, int(exc.code), now)
                 return int(exc.code)
         code = self._validate_suffix_chain(record, suffix)
         if code:
@@ -1568,6 +1699,11 @@ class TpuConsensusEngine(Generic[Scope]):
         host_accepted = 0
         host_transitions = 0
         host_owned_transitions = 0
+        # Per-signer health accounting, batched: admissions accumulate
+        # into one dict flushed in a single monitor call (_flush_vote_
+        # health), so the hot path pays dict stores, not per-vote locks.
+        admit_counts: dict[bytes, int] = {}
+        admit_timeout = 0.0
 
         # Batched signature verification: one scheme call for the whole batch
         # (native runtime: one GIL-releasing threaded C call). Verdicts are
@@ -1623,6 +1759,7 @@ class TpuConsensusEngine(Generic[Scope]):
                     )
                 except ConsensusError as exc:
                     statuses[i] = int(exc.code)
+                    self._note_reject_health(vote, int(exc.code), now)
                     continue
             if record.session is not None:
                 was_active = record.session.state.is_active
@@ -1630,6 +1767,10 @@ class TpuConsensusEngine(Generic[Scope]):
                 statuses[i] = code
                 if code == int(StatusCode.OK):
                     host_accepted += 1
+                    owner = vote.vote_owner
+                    admit_counts[owner] = admit_counts.get(owner, 0) + 1
+                    if record.config.consensus_timeout > admit_timeout:
+                        admit_timeout = record.config.consensus_timeout
                     self._timelines.voted(slot, now, wall)
                     if trace_store.enabled and record.trace is not None:
                         trace_store.instant(
@@ -1682,6 +1823,10 @@ class TpuConsensusEngine(Generic[Scope]):
             self._m_decisions.inc(host_owned_transitions)
             for _, ev_scope, event in host_events:
                 self._emit(ev_scope, event)
+            self._flush_vote_health(
+                items, statuses, admit_counts, admit_timeout, now,
+                pre_validated,
+            )
             return statuses
 
         k = len(dev_rows)
@@ -1731,8 +1876,14 @@ class TpuConsensusEngine(Generic[Scope]):
                 record.proposal.votes.append(stored)
                 record.scalar_seqs.append(record.next_arrival_seq())
                 record.bump_round(1)
+                admit_counts[stored.vote_owner] = (
+                    admit_counts.get(stored.vote_owner, 0) + 1
+                )
                 last_ok[int(slots[j])] = j
         for slot in last_ok:
+            cfg_timeout = self._records[slot].config.consensus_timeout
+            if cfg_timeout > admit_timeout:
+                admit_timeout = cfg_timeout
             self._timelines.voted(slot, now, wall)
             if trace_store.enabled:
                 tctx = self._records[slot].trace
@@ -1782,7 +1933,110 @@ class TpuConsensusEngine(Generic[Scope]):
         pending_events.sort(key=lambda t: t[0])
         for _, ev_scope, event in pending_events:
             self._emit(ev_scope, event)
+        self._flush_vote_health(
+            items, statuses, admit_counts, admit_timeout, now, pre_validated
+        )
         return statuses
+
+    # Duplicate-shaped statuses worth an equivocation probe: the session
+    # already holds a vote by this owner (device DUPLICATE_VOTE, scalar
+    # USER_ALREADY_VOTED) or absorbed a late vote post-decision
+    # (ALREADY_REACHED) — all three reached the engine AFTER signature
+    # admission, so a differing vote_hash means the owner validly signed
+    # two distinct votes for one proposal.
+    _EQUIVOCATION_PROBE_CODES = (
+        int(StatusCode.DUPLICATE_VOTE),
+        int(StatusCode.USER_ALREADY_VOTED),
+        int(StatusCode.ALREADY_REACHED),
+    )
+
+    def _flush_vote_health(
+        self,
+        items: "list[tuple[Scope, Vote]]",
+        statuses: np.ndarray,
+        admit_counts: "dict[bytes, int]",
+        admit_timeout: float,
+        now: int,
+        pre_validated: bool,
+    ) -> None:
+        """Per-batch health flush for ingest_votes: one batched admission
+        update, then an equivocation probe over the (rare) duplicate-shaped
+        rejections — two validly-signed votes with different hashes from
+        one owner on one proposal become a retained evidence pair
+        (obs.health module docstring)."""
+        if not self._health_live or not len(items):
+            return
+        if admit_counts:
+            self.health.note_admitted(
+                admit_counts, now, timeout_hint=admit_timeout
+            )
+        if pre_validated:
+            # No signature admission ran in THIS call (locally-built
+            # votes, WAL replay, already-validated suffixes): a
+            # duplicate-shaped rejection here must not mint a
+            # verified-evidence record — an embedder bug or forged
+            # replay row could otherwise fabricate "self-authenticating"
+            # proof and 503 the node. The network-facing paths (the
+            # only ones an attacker reaches) all validate, so coverage
+            # is unchanged where it matters.
+            return
+        # Candidate selection must stay cheap on the clean path. Scalar
+        # batches (the watermark/bridge shape) read one int; larger ones
+        # take ONE vectorized any() pass (OK == 0, so any nonzero means
+        # some rejection) before the per-code compares. np.isin is NOT
+        # used — it costs ~250us per call at small batch sizes, which
+        # alone would blow the redelivery budget.
+        if len(items) == 1:
+            if int(statuses[0]) not in self._EQUIVOCATION_PROBE_CODES:
+                return
+            rows = [0]
+        else:
+            if not statuses.any():
+                return
+            candidates = statuses == self._EQUIVOCATION_PROBE_CODES[0]
+            for code in self._EQUIVOCATION_PROBE_CODES[1:]:
+                candidates |= statuses == code
+            if not candidates.any():
+                return
+            rows = np.nonzero(candidates)[0].tolist()
+        last_key: "tuple | None" = None  # duplicates cluster per proposal
+        record: "SessionRecord[Scope] | None" = None
+        for i in rows:
+            scope, vote = items[i]
+            key = (scope, vote.proposal_id)
+            if key != last_key:
+                last_key = key
+                slot = self._index.get(key)
+                record = self._records[slot] if slot is not None else None
+            if record is None:
+                continue
+            prior = record.votes.get(vote.vote_owner)
+            if prior is not None and prior.vote_hash != vote.vote_hash:
+                self.health.note_equivocation(
+                    scope,
+                    vote.proposal_id,
+                    prior.encode(),
+                    vote.encode(),
+                    vote.vote_owner,
+                    now,
+                )
+
+    def _note_reject_health(self, vote: Vote, code: int, now: int) -> None:
+        """Scorecard attribution for per-vote admission rejections (the
+        identity is the vote's *claimed* signer — see
+        HealthMonitor.note_invalid_signature)."""
+        if not self._health_live:
+            return
+        if code in (
+            int(StatusCode.INVALID_VOTE_SIGNATURE),
+            int(StatusCode.INVALID_VOTE_HASH),
+            int(StatusCode.SIGNATURE_SCHEME),
+        ):
+            if vote.vote_owner:
+                self.health.note_invalid_signature(vote.vote_owner, now)
+        elif code == int(StatusCode.VOTE_EXPIRED):
+            if vote.vote_owner:
+                self.health.note_expired(vote.vote_owner, now)
 
     def voter_gid(self, owner: bytes) -> int:
         """Intern an owner identity for the columnar ingest path.
@@ -2582,6 +2836,10 @@ class TpuConsensusEngine(Generic[Scope]):
         slot = self._index.get((scope, proposal_id))
         if slot is None:
             raise SessionNotFound()
+        # Timeout calls carry the embedder's clock even when vote traffic
+        # has stopped — exactly when the liveness watchdog needs a
+        # current tick to measure silence against.
+        self.health.tick(now)
         record = self._records[slot]
         owned = self._owns_slot(slot)
         was_active = self._state_code(record) == STATE_ACTIVE
@@ -2666,6 +2924,7 @@ class TpuConsensusEngine(Generic[Scope]):
                     expired.append(slot)
         self.tracer.count("engine.timeout_sweeps")
         self.tracer.count("engine.timeouts_fired", len(expired) + len(host_expired))
+        self.health.tick(now)  # watchdog clock advances with the sweep cadence
         if expired or host_expired:
             flight_recorder.record(
                 "engine.sweep", fired=len(expired) + len(host_expired)
@@ -2959,6 +3218,21 @@ class TpuConsensusEngine(Generic[Scope]):
             "timeline": timeline,
             "trace": trace,
         }
+
+    def health_report(self, now: int | None = None) -> dict:
+        """Consensus-health snapshot: per-peer scorecards (graded), the
+        retained equivocation/fork evidence, liveness-watchdog state, and
+        the firing alert rules — :meth:`HealthMonitor.snapshot` plus this
+        engine's signer identity. ``now`` is the embedder's logical tick
+        (default: the latest tick the monitor has seen — HTTP scrapes
+        have no embedder clock). Exposed over the bridge as ``OP_HEALTH``
+        (``BridgeClient.health``); a
+        :class:`~hashgraph_tpu.wal.DurableEngine` overlays the WAL LSN
+        watermark. Deliberately NOT engine-locked: the monitor has its
+        own lock, so scrape threads never contend with ingest."""
+        out = self.health.snapshot(now)
+        out["identity"] = self._signer.identity().hex()
+        return out
 
     def export_session(self, scope: Scope, proposal_id: int) -> ConsensusSession:
         """Materialise a scalar ConsensusSession from the pooled state —

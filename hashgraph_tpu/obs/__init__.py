@@ -46,6 +46,15 @@ hashgraph_verify_cache_{hits,misses,negative_hits,evictions}_total  counter  Ver
 bridge_requests_total / bridge_errors_total     counter    bridge dispatch loop
 flight_dumps_total                              counter    flight recorder dump sites
 wal_checkpoints_total                           counter    DurableEngine checkpoints
+hashgraph_alerts_total (+ {rule=...})           counter    health alert rule rising edges
+hashgraph_equivocations_total                   counter    health evidence log (double-signs)
+hashgraph_fork_redeliveries_total               counter    health evidence log (watermark forks)
+hashgraph_truncation_redeliveries_total         counter    health scorecards (lagging chains)
+hashgraph_expired_gossip_total                  counter    health scorecards (stale redeliveries)
+hashgraph_tracked_peers / _evidence_records     gauge      default health monitor
+hashgraph_stale_peers                           gauge      liveness watchdog
+hashgraph_jax_live_buffer_bytes                 gauge      live JAX array bytes (scrape-time)
+hashgraph_jax_compile_cache_{hits,misses}_total counter    persistent XLA compile cache
 ==============================================  =========  ==================
 """
 
@@ -56,6 +65,20 @@ import functools
 import time
 
 from .flight import FlightRecorder, flight_recorder
+from .health import (
+    ALERTS_TOTAL,
+    EQUIVOCATIONS_TOTAL,
+    EVIDENCE_RECORDS,
+    EXPIRED_GOSSIP_TOTAL,
+    FORK_REDELIVERIES_TOTAL,
+    STALE_PEERS,
+    TRACKED_PEERS,
+    TRUNCATION_REDELIVERIES_TOTAL,
+    AlertRule,
+    EvidenceRecord,
+    HealthMonitor,
+    PeerScorecard,
+)
 from .http import MetricsSidecar
 from .registry import (
     DEFAULT_SIZE_BUCKETS,
@@ -112,6 +135,11 @@ VERIFY_CACHE_MISSES_TOTAL = "hashgraph_verify_cache_misses_total"
 VERIFY_CACHE_NEGATIVE_HITS_TOTAL = "hashgraph_verify_cache_negative_hits_total"
 VERIFY_CACHE_EVICTIONS_TOTAL = "hashgraph_verify_cache_evictions_total"
 BUILD_INFO = "hashgraph_build_info"
+# Device/XLA telemetry (providers installed by install_jax_telemetry —
+# called from engine construction so obs itself stays jax-free).
+JAX_LIVE_BUFFER_BYTES = "hashgraph_jax_live_buffer_bytes"
+JAX_COMPILE_CACHE_HITS_TOTAL = "hashgraph_jax_compile_cache_hits_total"
+JAX_COMPILE_CACHE_MISSES_TOTAL = "hashgraph_jax_compile_cache_misses_total"
 
 # Process-wide default registry (mirrors tracing.tracer's role).
 registry = MetricsRegistry()
@@ -136,6 +164,10 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         VOTE_TABLE_OCCUPANCY,
         WAL_SEGMENT_COUNT,
         WAL_SEGMENT_BYTES,
+        JAX_LIVE_BUFFER_BYTES,
+        TRACKED_PEERS,
+        EVIDENCE_RECORDS,
+        STALE_PEERS,
     ):
         reg.gauge(name)
     for name in (
@@ -152,6 +184,13 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         VERIFY_CACHE_MISSES_TOTAL,
         VERIFY_CACHE_NEGATIVE_HITS_TOTAL,
         VERIFY_CACHE_EVICTIONS_TOTAL,
+        ALERTS_TOTAL,
+        EQUIVOCATIONS_TOTAL,
+        FORK_REDELIVERIES_TOTAL,
+        TRUNCATION_REDELIVERIES_TOTAL,
+        EXPIRED_GOSSIP_TOTAL,
+        JAX_COMPILE_CACHE_HITS_TOTAL,
+        JAX_COMPILE_CACHE_MISSES_TOTAL,
     ):
         reg.counter(name)
     reg.info(BUILD_INFO).set(
@@ -215,6 +254,71 @@ def _jax_backend() -> str:
 _install_well_known(registry)
 flight_recorder.dump_counter = registry.counter(FLIGHT_DUMPS_TOTAL)
 
+# Process-wide default health monitor (mirrors ``registry``'s role):
+# engines not given their own share this one, so a bridge server's
+# co-hosted peers accumulate one fleet view; its anomaly counters and
+# point-in-time gauges land on the default registry above.
+health_monitor = HealthMonitor(registry=registry)
+health_monitor.register_gauges(registry)
+
+
+def _jax_live_buffer_bytes() -> int:
+    """Bytes held by live JAX arrays — sampled at scrape time, and only
+    when something else already initialized the runtime (naming device
+    memory must never be the thing that grabs it; same discipline as
+    ``_jax_backend``)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge._backends:
+            return 0
+        return sum(int(getattr(a, "nbytes", 0)) for a in jax.live_arrays())
+    except Exception:
+        return 0
+
+
+registry.register_gauge(JAX_LIVE_BUFFER_BYTES, _jax_live_buffer_bytes)
+
+_jax_telemetry_installed = False
+
+
+def install_jax_telemetry(reg: MetricsRegistry | None = None) -> bool:
+    """Route JAX's persistent-compilation-cache monitoring events
+    (``/jax/compilation_cache/cache_hits`` / ``cache_misses``) onto the
+    registry's counters. Idempotent; returns True once installed. Called
+    from engine construction (which imports JAX anyway) so this module
+    stays importable without JAX and never forces the runtime up."""
+    global _jax_telemetry_installed
+    if _jax_telemetry_installed:
+        return True
+    target = reg if reg is not None else registry
+    try:
+        from jax import monitoring as jax_monitoring
+    except Exception:
+        return False
+    hits = target.counter(JAX_COMPILE_CACHE_HITS_TOTAL)
+    misses = target.counter(JAX_COMPILE_CACHE_MISSES_TOTAL)
+
+    def _on_event(event: str, **kwargs) -> None:
+        if "/compilation_cache/" not in event:
+            return
+        if event.endswith("cache_hits"):
+            hits.inc()
+        elif event.endswith("cache_misses"):
+            misses.inc()
+
+    try:
+        jax_monitoring.register_event_listener(_on_event)
+    except Exception:
+        return False
+    _jax_telemetry_installed = True
+    return True
+
 
 @contextlib.contextmanager
 def observed_span(tracer, name: str, histogram: Histogram, **attrs):
@@ -249,14 +353,18 @@ def observed_span(tracer, name: str, histogram: Histogram, **attrs):
 
 
 __all__ = [
+    "AlertRule",
     "Counter",
+    "EvidenceRecord",
     "FlightRecorder",
     "Gauge",
     "GaugeHandle",
+    "HealthMonitor",
     "Histogram",
     "Info",
     "MetricsRegistry",
     "MetricsSidecar",
+    "PeerScorecard",
     "ProposalTimeline",
     "TimelineStore",
     "TraceContext",
@@ -266,6 +374,8 @@ __all__ = [
     "current_context",
     "extract_trace",
     "flight_recorder",
+    "health_monitor",
+    "install_jax_telemetry",
     "log_buckets",
     "merge_traces",
     "observed_span",

@@ -58,10 +58,19 @@ def render(registry) -> str:
         )
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name}{{{labels}}} 1")
+    # Counters may carry a pre-labelled name (``family{rule="x"}``, the
+    # health layer's per-rule alert counters): the base name is sanitized,
+    # the label block passes through verbatim, and the TYPE line is
+    # emitted once per base — the sort above keeps a family's labelled
+    # samples adjacent to the bare one, as the text format requires.
+    prev_base = None
     for c in counters:
-        name = sanitize(c.name)
-        lines.append(f"# TYPE {name} counter")
-        lines.append(f"{name} {_fmt(c.value)}")
+        base, brace, labels = c.name.partition("{")
+        name = sanitize(base)
+        if name != prev_base:
+            lines.append(f"# TYPE {name} counter")
+            prev_base = name
+        lines.append(f"{name}{brace}{labels} {_fmt(c.value)}")
     for g in gauges:
         name = sanitize(g.name)
         lines.append(f"# TYPE {name} gauge")

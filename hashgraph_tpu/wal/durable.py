@@ -194,12 +194,24 @@ class DurableEngine:
         policy) and the last checkpoint watermark (records at or below it
         are also covered by a snapshot)."""
         out = self._engine.explain_decision(scope, proposal_id)
-        out["wal"] = {
+        out["wal"] = self._wal_overlay()
+        return out
+
+    def health_report(self, now=None) -> dict:
+        """Engine health snapshot (scorecards / evidence / watchdog /
+        alerts) plus this peer's durability position — same overlay as
+        :meth:`explain_decision`, so an operator reading one health blob
+        also knows what a crash right now would and would not lose."""
+        out = self._engine.health_report(now)
+        out["wal"] = self._wal_overlay()
+        return out
+
+    def _wal_overlay(self) -> dict:
+        return {
             "last_lsn": self._wal.last_lsn,
             "checkpoint_watermark": self._ckpt_watermark,
             "fsync_policy": self._wal.fsync_policy,
         }
-        return out
 
     # ── Recovery ───────────────────────────────────────────────────────
 

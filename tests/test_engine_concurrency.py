@@ -89,6 +89,50 @@ class TestEngineConcurrency:
         assert outcomes.count(int(StatusCode.OK)) == 1
         assert outcomes.count(int(StatusCode.DUPLICATE_VOTE)) == 4
 
+    def test_scorecard_accounting_under_concurrent_batches(self):
+        """8 threads each ingest a distinct batch of validated votes;
+        the health scorecards must account every admission exactly once
+        (no lost updates across the monitor's lock) and grade everyone
+        healthy."""
+        from hashgraph_tpu.obs import MetricsRegistry
+        from hashgraph_tpu.obs.health import GRADE_HEALTHY, HealthMonitor
+
+        monitor = HealthMonitor(registry=MetricsRegistry())
+        engine = TpuConsensusEngine(
+            random_stub_signer(),
+            capacity=16,
+            voter_capacity=64,
+            health_monitor=monitor,
+        )
+        engine.scope("s").with_threshold(1.0).initialize()
+        pid = engine.create_proposal("s", request(64), NOW).proposal_id
+        base = engine.get_proposal("s", pid)
+        signers = [random_stub_signer() for _ in range(32)]
+        votes = [build_vote(base, True, s, NOW) for s in signers]
+        batches = [votes[i::8] for i in range(8)]
+        barrier = threading.Barrier(8)
+        counts = []
+        lock = threading.Lock()
+
+        def worker(batch):
+            barrier.wait()
+            st = engine.ingest_votes(
+                [("s", v) for v in batch], NOW, pre_validated=True
+            )
+            with lock:
+                counts.append(sum(int(c) == int(StatusCode.OK) for c in st))
+
+        threads = [threading.Thread(target=worker, args=(b,)) for b in batches]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(counts) == 32
+        cards = [monitor.scorecard(s.identity()) for s in signers]
+        assert all(c is not None and c["votes_admitted"] == 1 for c in cards)
+        assert {c["grade"] for c in cards} == {GRADE_HEALTHY}
+        assert monitor.evidence_count() == 0
+
     def test_parallel_proposal_creation(self):
         engine = TpuConsensusEngine(
             random_stub_signer(), capacity=64, voter_capacity=8,

@@ -506,6 +506,42 @@ class TestBridgeObservability:
         finally:
             blocker.close()
 
+    def test_healthz_degraded_reasons_schema(self):
+        """The enriched /healthz body: 'alerts' always present; a firing
+        critical rule adds machine-readable 'reasons' (rule / severity /
+        details) and flips the status to 503 — the schema a load
+        balancer's operator scripts against."""
+        from hashgraph_tpu.obs.health import HealthMonitor
+
+        monitor = HealthMonitor(registry=MetricsRegistry())
+        with BridgeServer(
+            capacity=8, voter_capacity=8, metrics_port=0,
+            health_monitor=monitor,
+        ) as server:
+            host, port = server.metrics_address
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=5
+            ) as response:
+                healthy = json.loads(response.read())
+            assert healthy["ok"] is True
+            assert healthy["alerts"] == [] and "reasons" not in healthy
+
+            monitor.note_equivocation("s", 7, b"\x01", b"\x02", b"\x09" * 20, 1)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"http://{host}:{port}/healthz", timeout=5)
+            assert err.value.code == 503
+            degraded = json.loads(err.value.read())
+            assert degraded["ok"] is False
+            assert isinstance(degraded["reasons"], list) and degraded["reasons"]
+            for reason in degraded["reasons"]:
+                assert set(reason) == {
+                    "rule", "severity", "description", "details",
+                }
+                assert reason["severity"] == "critical"
+            # Warnings ride along in alerts without appearing in reasons.
+            rules_in_alerts = {a["rule"] for a in degraded["alerts"]}
+            assert "peer-faulty" in rules_in_alerts
+
     def test_requests_counter_advances(self):
         before = global_registry.counter("bridge_requests_total").value
         with BridgeServer(capacity=8, voter_capacity=8) as server:
