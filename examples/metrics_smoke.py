@@ -49,6 +49,13 @@ REQUIRED_FAMILIES = [
     "hashgraph_jax_live_buffer_bytes",
     "hashgraph_jax_compile_cache_hits_total",
     "hashgraph_jax_compile_cache_misses_total",
+    # Verify-pool + scheme telemetry: the native pool's backlog gauge
+    # (0 when the runtime is absent — the gauge must still exist), the
+    # signatures-verified counter, and its per-scheme labelled variant
+    # (registered at engine construction).
+    "hashgraph_verify_pool_queue_depth",
+    "hashgraph_verified_signatures_total",
+    'hashgraph_verified_signatures_total{scheme="',
 ]
 
 

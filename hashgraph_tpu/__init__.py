@@ -60,6 +60,7 @@ from .service import ConsensusService, ConsensusStats, ScopeConfigBuilderWrapper
 from .session import ConsensusConfig, ConsensusSession, ConsensusState
 from .signing import (
     ConsensusSignatureScheme,
+    Ed25519ConsensusSigner,
     EthereumConsensusSigner,
     StubConsensusSigner,
 )
@@ -115,6 +116,7 @@ __all__ = [
     "ConsensusFailedEvent",
     "SessionTransition",
     "ConsensusSignatureScheme",
+    "Ed25519ConsensusSigner",
     "EthereumConsensusSigner",
     "StubConsensusSigner",
     "build_vote",

@@ -51,6 +51,7 @@ from ..obs import (
     LIVE_PROPOSALS,
     PROPOSALS_CREATED_TOTAL,
     TIMEOUTS_FIRED_TOTAL,
+    VERIFIED_SIGNATURES_TOTAL,
     VERIFY_BATCH_SECONDS,
     VOTE_TABLE_OCCUPANCY,
     VOTES_ACCEPTED_TOTAL,
@@ -59,6 +60,7 @@ from ..obs import (
     flight_recorder,
     observed_span,
 )
+from ..obs.prometheus import _escape_label
 from ..obs import health_monitor as default_health_monitor
 from ..obs import install_jax_telemetry
 from ..obs import registry as default_registry
@@ -247,6 +249,34 @@ class SessionRecord(Generic[Scope]):
             self.proposal.round = min(self.proposal.round + accepted, _U32_MAX)
 
 
+class PendingVoteVerdicts:
+    """Handle for an in-flight admission-verify prepass
+    (:meth:`TpuConsensusEngine.verify_votes_async`): ``collect()`` blocks
+    until the signature batch resolves and returns ``(verdicts,
+    computed_hashes)`` aligned with the submitted votes. Idempotent —
+    the first collect does the waiting. While uncollected, the crypto
+    runs on the native verify pool with no GIL involvement, so the
+    interpreter is free to drive device ingest of an earlier batch."""
+
+    __slots__ = ("_collect_fn", "_result")
+
+    def __init__(self, collect_fn):
+        self._collect_fn = collect_fn
+        self._result = None
+
+    def collect(self) -> "tuple[list, list[bytes]]":
+        if self._collect_fn is not None:
+            self._result = self._collect_fn()
+            self._collect_fn = None
+        return self._result
+
+
+# Sentinel: "compute the signature prepass inside ingest_votes" (the
+# non-pipelined default) as opposed to an explicit None / prepass handle
+# handed in by ingest_votes_pipelined.
+_PREPASS_INLINE = object()
+
+
 class TpuConsensusEngine(Generic[Scope]):
     """Batch consensus engine with the ConsensusService API surface.
 
@@ -352,6 +382,13 @@ class TpuConsensusEngine(Generic[Scope]):
             INGEST_BATCH_SIZE, DEFAULT_SIZE_BUCKETS
         )
         self._m_verify = self.metrics.histogram(VERIFY_BATCH_SECONDS)
+        # Signatures actually handed to the scheme (cache hits excluded):
+        # the base family plus a per-scheme labelled variant, so a mixed
+        # fleet's dashboards can split Ed25519 batch traffic from ECDSA.
+        self._m_verified_sigs = self.metrics.counter(VERIFIED_SIGNATURES_TOTAL)
+        self._m_verified_sigs_scheme = self.metrics.counter(
+            f'{VERIFIED_SIGNATURES_TOTAL}{{scheme="{_escape_label(scheme.__name__)}"}}'
+        )
         self._m_chain = self.metrics.histogram(CHAIN_KERNEL_SECONDS)
         self._m_device = self.metrics.histogram(DEVICE_INGEST_SECONDS)
         self._m_suffix_len = self.metrics.histogram(
@@ -1031,10 +1068,14 @@ class TpuConsensusEngine(Generic[Scope]):
             start = len(flat_votes)
             flat_votes.extend(proposal.votes)
             spans.append((start, len(proposal.votes)))
-        verdicts: list = []
-        vote_hashes: list = []
-        if flat_votes:
-            verdicts, vote_hashes = self._cached_verify(flat_votes)
+        # Crypto/device pipelining: the signature batch is SUBMITTED to
+        # the verify pool here, the chain kernel below dispatches to the
+        # device while the pool verifies, and the verdicts are collected
+        # only when both are needed — host ECDSA/Ed25519 and device chain
+        # validation for the same call overlap instead of serializing.
+        pending_verify = (
+            self._cached_verify_begin(flat_votes) if flat_votes else None
+        )
 
         # Bulk chain validation on device (only chains that need it).
         chain_errors: dict[int, ConsensusError | None] = {}
@@ -1069,6 +1110,11 @@ class TpuConsensusEngine(Generic[Scope]):
                 code = first_chain_error(chain_statuses[j])
                 exc_cls = error_for_code(code) if code else None
                 chain_errors[i] = exc_cls() if exc_cls is not None else None
+
+        verdicts: list = []
+        vote_hashes: list = []
+        if pending_verify is not None:
+            verdicts, vote_hashes = pending_verify.collect()
 
         for i, (scope, proposal) in enumerate(items):
             if (scope, proposal.proposal_id) in self._index:
@@ -1548,12 +1594,40 @@ class TpuConsensusEngine(Generic[Scope]):
     def _cached_verify(
         self, votes: "list[Vote]"
     ) -> "tuple[list, list[bytes]]":
-        """Signature verdicts for ``votes`` through the admission cache:
-        in-batch dedup (identical votes across many chains collapse to one
-        verify item), cache consultation, ONE scheme.verify_batch over the
-        surviving misses, verdict fan-out, cache population. Returns
-        (verdicts, computed_hashes) aligned with ``votes`` — callers feed
-        both into validate_vote so the SHA pass here is the only one.
+        """Synchronous admission-verify prepass: exactly
+        ``_cached_verify_begin(votes).collect()`` (see there)."""
+        return self._cached_verify_begin(votes).collect()
+
+    def verify_votes_async(self, votes: "list[Vote]") -> "PendingVoteVerdicts":
+        """Public admission-verify prepass for pipelining embedders.
+
+        Starts the full host validation front half NOW — vote-hash
+        recompute, structural prechecks, verify-cache consult, and the
+        signature batch submitted to the scheme (on the native worker
+        pool, the crypto runs GIL-free in the background) — and returns a
+        handle whose ``collect()`` yields ``(verdicts, computed_hashes)``
+        aligned with ``votes``, exactly what the engine's own entry
+        points consume. Embedders that drive :meth:`ingest_columnar`
+        with pre-validated traffic use this to overlap batch k+1's
+        crypto with batch k's device ingest (the `bench.py
+        validated-sweep` cold path); verdicts must all be True and each
+        ``computed_hash`` must equal the vote's ``vote_hash`` before the
+        rows may be ingested as validated."""
+        return self._cached_verify_begin(votes)
+
+    def _cached_verify_begin(self, votes: "list[Vote]") -> "PendingVoteVerdicts":
+        """Signature verdicts for ``votes`` through the admission cache,
+        in two halves. This half: in-batch dedup (identical votes across
+        many chains collapse to one verify item), cache consultation, and
+        ONE scheme.verify_batch_submit over the surviving misses — the
+        crypto is in flight on the verify pool when this returns. The
+        ``collect()`` half: await verdicts, fan out, populate the cache,
+        and return (verdicts, computed_hashes) aligned with ``votes`` —
+        callers feed both into validate_vote so the SHA pass here is the
+        only one. The verify-batch histogram observes the *collect* wait,
+        so a well-overlapped pipeline shows near-zero residence while an
+        unpipelined caller still sees the full verify cost (begin is
+        immediately followed by collect).
 
         With the cache disabled this is a plain batched verify (identical
         to the pre-cache flow). Admission keys are derived from each
@@ -1568,19 +1642,25 @@ class TpuConsensusEngine(Generic[Scope]):
         hashes = [compute_vote_hash(v) for v in votes]
         if self._verify_cache is None:
             if not votes:
-                return [], hashes
-            with observed_span(
-                self.tracer,
-                "engine.verify_batch",
-                self._m_verify,
-                votes=len(votes),
-            ):
-                verdicts = self._scheme.verify_batch(
-                    [v.vote_owner for v in votes],
-                    [v.signing_payload() for v in votes],
-                    [v.signature for v in votes],
-                )
-            return list(verdicts), hashes
+                return PendingVoteVerdicts(lambda: ([], hashes))
+            pending = self._scheme.verify_batch_submit(
+                [v.vote_owner for v in votes],
+                [v.signing_payload() for v in votes],
+                [v.signature for v in votes],
+            )
+
+            def _finish_uncached():
+                with observed_span(
+                    self.tracer,
+                    "engine.verify_batch",
+                    self._m_verify,
+                    votes=len(votes),
+                ):
+                    verdicts = pending.collect()
+                self._note_verified(len(votes))
+                return list(verdicts), hashes
+
+            return PendingVoteVerdicts(_finish_uncached)
         cache = self._verify_cache
         verdicts: list = [False] * len(votes)
         rows: list[int] = []
@@ -1611,24 +1691,35 @@ class TpuConsensusEngine(Generic[Scope]):
             else:
                 miss_rows.setdefault(key, []).append(i)
                 miss_payloads.setdefault(key, payload)
-        if miss_rows:
-            rep = [rows[0] for rows in miss_rows.values()]
+        if not miss_rows:
+            return PendingVoteVerdicts(lambda: (verdicts, hashes))
+        rep = [r[0] for r in miss_rows.values()]
+        pending = self._scheme.verify_batch_submit(
+            [votes[i].vote_owner for i in rep],
+            list(miss_payloads.values()),
+            [votes[i].signature for i in rep],
+        )
+
+        def _finish():
             with observed_span(
                 self.tracer,
                 "engine.verify_batch",
                 self._m_verify,
                 votes=len(rep),
             ):
-                fresh = self._scheme.verify_batch(
-                    [votes[i].vote_owner for i in rep],
-                    list(miss_payloads.values()),
-                    [votes[i].signature for i in rep],
-                )
+                fresh = pending.collect()
+            self._note_verified(len(rep))
             for (_, miss), verdict in zip(miss_rows.items(), fresh):
                 for i in miss:
                     verdicts[i] = verdict
             cache.put_many(list(zip(miss_rows, fresh)))
-        return verdicts, hashes
+            return verdicts, hashes
+
+        return PendingVoteVerdicts(_finish)
+
+    def _note_verified(self, count: int) -> None:
+        self._m_verified_sigs.inc(count)
+        self._m_verified_sigs_scheme.inc(count)
 
     def cast_vote(self, scope: Scope, proposal_id: int, choice: bool, now: int) -> Vote:
         """Sign, chain, and apply this peer's vote
@@ -1664,11 +1755,78 @@ class TpuConsensusEngine(Generic[Scope]):
         if exc is not None:
             raise exc()
 
+    def _vote_prepass_begin(
+        self, items: "list[tuple[Scope, Vote]]", pre_validated: bool
+    ) -> "tuple[list[int], PendingVoteVerdicts] | None":
+        """Start the batched signature prepass for an ingest_votes batch:
+        resolve which rows have a locally-owned session (the same filter
+        the apply loop uses), and submit their signatures through the
+        admission cache to the verify pool. Returns (row indices, pending
+        handle), or None when the batch takes no prepass (pre-validated,
+        or a cacheless scalar call).
+
+        Safe to call for batch k+1 BEFORE batch k applies — that is the
+        double-buffered pipeline — because ingest_votes never registers,
+        evicts, or unregisters sessions: the ``_index`` resolution and
+        everything the prepass reads are invariant across vote applies.
+        (Interleaving proposal registration/eviction between begin and
+        apply is NOT supported; ingest_votes_pipelined only chains vote
+        batches, so the invariant holds by construction.)"""
+        batch = len(items)
+        if pre_validated or not (
+            batch > 1 or (batch == 1 and self._verify_cache is not None)
+        ):
+            return None
+        idxs = [
+            i
+            for i, (scope, vote) in enumerate(items)
+            if (slot := self._index.get((scope, vote.proposal_id))) is not None
+            and (slot < 0 or self._owns_slot(slot))  # skip misrouted rows
+        ]
+        if not idxs:
+            return None
+        return idxs, self._cached_verify_begin([items[i][1] for i in idxs])
+
+    def ingest_votes_pipelined(
+        self,
+        batches: "list[list[tuple[Scope, Vote]]]",
+        now: int,
+        pre_validated: bool = False,
+    ) -> "list[np.ndarray]":
+        """Double-buffered :meth:`ingest_votes` over consecutive batches:
+        batch k+1's signature prepass is submitted to the verify pool
+        BEFORE batch k applies, so host crypto overlaps the previous
+        batch's device dispatch and host bookkeeping. Result-identical to
+        ``[ingest_votes(b, now, pre_validated) for b in batches]`` — the
+        prepass is order-invariant across vote applies (see
+        :meth:`_vote_prepass_begin`), statuses and events fire in the
+        same per-batch order, and with the native pool absent the
+        deferred-sync fallback restores today's sequential behavior byte
+        for byte."""
+        results: "list[np.ndarray]" = []
+        prev: "tuple[list[tuple[Scope, Vote]], object] | None" = None
+        for items in batches:
+            items = list(items)
+            prepass = self._vote_prepass_begin(items, pre_validated)
+            if prev is not None:
+                results.append(
+                    self.ingest_votes(
+                        prev[0], now, pre_validated, _prepass=prev[1]
+                    )
+                )
+            prev = (items, prepass)
+        if prev is not None:
+            results.append(
+                self.ingest_votes(prev[0], now, pre_validated, _prepass=prev[1])
+            )
+        return results
+
     def ingest_votes(
         self,
         items: list[tuple[Scope, Vote]],
         now: int,
         pre_validated: bool = False,
+        _prepass=_PREPASS_INLINE,
     ) -> np.ndarray:
         """THE batch hot path: apply many votes across many sessions/scopes
         in one device dispatch.
@@ -1679,6 +1837,11 @@ class TpuConsensusEngine(Generic[Scope]):
         arrival-ordered ingest kernel. Emits ConsensusReached events for every
         session the batch decides. Returns int32 status codes in batch order
         (StatusCode.OK / ALREADY_REACHED are successes).
+
+        ``_prepass`` (private) lets :meth:`ingest_votes_pipelined` hand in
+        a signature prepass it already started for this batch; the
+        default recomputes it inline, which is the same thing minus the
+        overlap.
         """
         batch = len(items)
         self.tracer.count("engine.votes_in", batch)
@@ -1706,29 +1869,23 @@ class TpuConsensusEngine(Generic[Scope]):
         admit_timeout = 0.0
 
         # Batched signature verification: one scheme call for the whole batch
-        # (native runtime: one GIL-releasing threaded C call). Verdicts are
+        # (native runtime: one pool-fanned C batch, GIL-free). Verdicts are
         # injected into the per-vote check sequence, preserving exact scalar
         # error precedence. With the admission cache enabled the prepass
         # also covers batch == 1 (the process_incoming_vote / bridge scalar
         # path hits the cache too), dedups identical votes within the
-        # batch, and only the cache misses reach the scheme.
+        # batch, and only the cache misses reach the scheme. A pipelined
+        # caller hands in the prepass it began before the PREVIOUS batch
+        # applied; the crypto has been running in the background since.
         sig_verdicts: dict[int, object] = {}
         vote_hashes: dict[int, bytes] = {}
-        if not pre_validated and (
-            batch > 1 or (batch == 1 and self._verify_cache is not None)
-        ):
-            idxs = [
-                i
-                for i, (scope, vote) in enumerate(items)
-                if (slot := self._index.get((scope, vote.proposal_id))) is not None
-                and (slot < 0 or self._owns_slot(slot))  # skip misrouted rows
-            ]
-            if idxs:
-                verdicts, hashes = self._cached_verify(
-                    [items[i][1] for i in idxs]
-                )
-                sig_verdicts = dict(zip(idxs, verdicts))
-                vote_hashes = dict(zip(idxs, hashes))
+        if _prepass is _PREPASS_INLINE:
+            _prepass = self._vote_prepass_begin(items, pre_validated)
+        if _prepass is not None:
+            idxs, pending = _prepass
+            verdicts, hashes = pending.collect()
+            sig_verdicts = dict(zip(idxs, verdicts))
+            vote_hashes = dict(zip(idxs, hashes))
 
         for i, (scope, vote) in enumerate(items):
             slot = self._index.get((scope, vote.proposal_id))

@@ -97,7 +97,7 @@ def _load() -> ctypes.CDLL | None:
             lib = ctypes.CDLL(path)
         except OSError:
             return None
-        _NEWEST_SYMBOL = "hg_gids_live"  # bump when the ABI grows
+        _NEWEST_SYMBOL = "hg_ed25519_verify_batch_submit"  # bump when the ABI grows
         if not hasattr(lib, _NEWEST_SYMBOL):
             # Stale artifact (e.g. a cached build from an older checkout):
             # rebuild the default path once, else give up.
@@ -137,7 +137,32 @@ def _load() -> ctypes.CDLL | None:
             i64p, ctypes.c_int64, u8p, i64p,
             ctypes.c_int64, u8p, ctypes.c_int,
         ]
-        if lib.hg_version() < 2:
+        # Persistent verify pool (v3 ABI).
+        lib.hg_pool_configure.restype = ctypes.c_int
+        lib.hg_pool_configure.argtypes = [ctypes.c_int]
+        lib.hg_pool_size.restype = ctypes.c_int
+        lib.hg_pool_queue_depth.restype = ctypes.c_int64
+        lib.hg_pool_wait.restype = ctypes.c_int
+        lib.hg_pool_wait.argtypes = [ctypes.c_int64]
+        lib.hg_eth_verify_batch_submit.restype = ctypes.c_int64
+        lib.hg_eth_verify_batch_submit.argtypes = [
+            u8p, u8p, u64p, u8p, ctypes.c_int64, u8p,
+        ]
+        # Ed25519 (v3 ABI).
+        lib.hg_ed25519_public.restype = ctypes.c_int
+        lib.hg_ed25519_public.argtypes = [u8p, u8p]
+        lib.hg_ed25519_sign.restype = ctypes.c_int
+        lib.hg_ed25519_sign.argtypes = [u8p, u8p, ctypes.c_uint64, u8p]
+        lib.hg_ed25519_verify.restype = ctypes.c_int
+        lib.hg_ed25519_verify.argtypes = [u8p, u8p, ctypes.c_uint64, u8p]
+        lib.hg_ed25519_verify_batch.argtypes = [
+            u8p, u8p, u64p, u8p, ctypes.c_int64, u8p, ctypes.c_int,
+        ]
+        lib.hg_ed25519_verify_batch_submit.restype = ctypes.c_int64
+        lib.hg_ed25519_verify_batch_submit.argtypes = [
+            u8p, u8p, u64p, u8p, ctypes.c_int64, u8p,
+        ]
+        if lib.hg_version() < 3:
             return None
         _lib = lib
         return _lib
@@ -155,6 +180,14 @@ def _u8(buf) -> ctypes.POINTER(ctypes.c_uint8):
 
 def _np_u8p(arr: np.ndarray):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _joined_u8(items: "list[bytes]") -> np.ndarray:
+    """Concatenate byte strings into one uint8 view WITHOUT a second
+    copy: ``b"".join`` already materializes a fresh buffer, and the C
+    side never writes these, so a read-only ``frombuffer`` view over the
+    joined bytes is enough (the array keeps the bytes object alive)."""
+    return np.frombuffer(b"".join(items) or b"\x00", np.uint8)
 
 
 def keccak256(data: bytes) -> bytes | None:
@@ -253,7 +286,7 @@ def _hash_batch(items: list[bytes], n_threads: int, fn_name: str) -> np.ndarray 
     lib = _load()
     if lib is None:
         return None
-    data = np.frombuffer(b"".join(items) or b"\x00", np.uint8).copy()
+    data = _joined_u8(items)
     offsets = np.zeros(len(items) + 1, np.uint64)
     np.cumsum([len(b) for b in items], out=offsets[1:])
     out = np.empty((len(items), 32), np.uint8)
@@ -289,9 +322,9 @@ def eth_verify_batch(
     if lib is None:
         return None
     k = len(identities)
-    ids = np.frombuffer(b"".join(identities) or b"\x00", np.uint8).copy()
-    sigs = np.frombuffer(b"".join(signatures) or b"\x00", np.uint8).copy()
-    data = np.frombuffer(b"".join(payloads) or b"\x00", np.uint8).copy()
+    ids = _joined_u8(identities)
+    sigs = _joined_u8(signatures)
+    data = _joined_u8(payloads)
     offsets = np.zeros(k + 1, np.uint64)
     np.cumsum([len(b) for b in payloads], out=offsets[1:])
     out = np.empty(k, np.uint8)
@@ -323,3 +356,194 @@ def eth_address(private_key: bytes) -> bytes | None:
     out = np.empty(20, np.uint8)
     rc = lib.hg_eth_address(_u8(private_key), _np_u8p(out))
     return out.tobytes() if rc == 0 else None
+
+
+# ── Persistent verify pool ─────────────────────────────────────────────
+
+
+class VerifyJob:
+    """Handle for an in-flight native verify batch.
+
+    The worker pool fills ``out`` in the background with no GIL
+    involvement; :meth:`collect` blocks until every chunk completed and
+    returns the result codes. The job object keeps every marshalled
+    buffer alive until collection — the C side borrows the pointers, so
+    the buffers must outlive the workers: a job dropped UNCOLLECTED
+    waits for its chunks in ``__del__`` before the buffers can be freed
+    (the crypto is already running; the wait is bounded by work that was
+    going to happen anyway — never let the GC race a worker's writes).
+    """
+
+    __slots__ = ("_lib", "_handle", "out", "_keepalive", "_collected")
+
+    def __init__(self, lib, handle: int, out: np.ndarray, keepalive: tuple):
+        self._lib = lib
+        self._handle = handle
+        self.out = out
+        self._keepalive = keepalive
+        self._collected = False
+
+    def collect(self) -> np.ndarray:
+        """Wait for the batch and return its result codes (uint8[K])."""
+        if not self._collected:
+            self._lib.hg_pool_wait(self._handle)
+            self._collected = True
+        return self.out
+
+    def __del__(self):
+        try:
+            self.collect()
+        except Exception:
+            pass  # interpreter teardown: the process outlives the pool
+
+
+def pool_configure(n_threads: int) -> int | None:
+    """(Re)size the persistent verify pool (<= 0 restores the hardware
+    default). Returns the resulting worker count, or None when the
+    native runtime is absent. Call between batches, not mid-flight."""
+    lib = _load()
+    if lib is None:
+        return None
+    return lib.hg_pool_configure(n_threads)
+
+
+def pool_size() -> int | None:
+    lib = _load()
+    if lib is None:
+        return None
+    return lib.hg_pool_size()
+
+
+def pool_queue_depth() -> int | None:
+    """Verify-pool tasks queued + running, or None when unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    return lib.hg_pool_queue_depth()
+
+
+def pool_queue_depth_if_loaded() -> int:
+    """Metrics-safe queue depth: 0 unless the runtime is ALREADY loaded.
+    Scrape paths use this — naming the gauge must never be the thing
+    that compiles or dlopens the native library."""
+    lib = _lib
+    return int(lib.hg_pool_queue_depth()) if lib is not None else 0
+
+
+def _submit_batch(lib, fn, fixed_arrays: tuple, payloads: "list[bytes]",
+                  count: int) -> VerifyJob:
+    data = _joined_u8(payloads)
+    offsets = np.zeros(count + 1, np.uint64)
+    np.cumsum([len(b) for b in payloads], out=offsets[1:])
+    out = np.empty(count, np.uint8)
+    handle = fn(
+        _np_u8p(fixed_arrays[0]),
+        _np_u8p(data),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        _np_u8p(fixed_arrays[1]),
+        count,
+        _np_u8p(out),
+    )
+    return VerifyJob(lib, handle, out, (fixed_arrays, data, offsets))
+
+
+def eth_verify_batch_submit(
+    identities: list[bytes],
+    payloads: list[bytes],
+    signatures: list[bytes],
+) -> VerifyJob | None:
+    """Async :func:`eth_verify_batch`: returns immediately with a
+    :class:`VerifyJob` whose ``collect()`` yields the same uint8 codes
+    (1 valid, 0 mismatch, 255 malformed recovery byte, 254 recovery
+    failed), or None if the runtime is unavailable. Caller guarantees
+    20-byte identities and 65-byte signatures."""
+    lib = _load()
+    if lib is None:
+        return None
+    return _submit_batch(
+        lib,
+        lib.hg_eth_verify_batch_submit,
+        (_joined_u8(identities), _joined_u8(signatures)),
+        payloads,
+        len(identities),
+    )
+
+
+# ── Ed25519 ────────────────────────────────────────────────────────────
+
+
+def ed25519_public(seed: bytes) -> bytes | None:
+    """32-byte public key for a 32-byte seed, or None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty(32, np.uint8)
+    lib.hg_ed25519_public(_u8(seed), _np_u8p(out))
+    return out.tobytes()
+
+
+def ed25519_sign(seed: bytes, payload: bytes) -> bytes | None:
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty(64, np.uint8)
+    lib.hg_ed25519_sign(_u8(seed), _u8(payload), len(payload), _np_u8p(out))
+    return out.tobytes()
+
+
+def ed25519_verify(pub: bytes, payload: bytes, signature: bytes) -> int | None:
+    """1 valid, 0 invalid (cofactored verification; bad encodings and a
+    non-canonical s also report 0); None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    return lib.hg_ed25519_verify(_u8(pub), _u8(payload), len(payload), _u8(signature))
+
+
+def ed25519_verify_batch(
+    pubs: list[bytes],
+    payloads: list[bytes],
+    signatures: list[bytes],
+    n_threads: int = 0,
+) -> np.ndarray | None:
+    """uint8[K]: 1 valid, 0 invalid; None if unavailable. Caller
+    guarantees 32-byte pubs and 64-byte signatures. Chunks verify as one
+    randomized linear combination across the worker pool."""
+    lib = _load()
+    if lib is None:
+        return None
+    k = len(pubs)
+    ids = _joined_u8(pubs)
+    sigs = _joined_u8(signatures)
+    data = _joined_u8(payloads)
+    offsets = np.zeros(k + 1, np.uint64)
+    np.cumsum([len(b) for b in payloads], out=offsets[1:])
+    out = np.empty(k, np.uint8)
+    lib.hg_ed25519_verify_batch(
+        _np_u8p(ids),
+        _np_u8p(data),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        _np_u8p(sigs),
+        k,
+        _np_u8p(out),
+        n_threads,
+    )
+    return out
+
+
+def ed25519_verify_batch_submit(
+    pubs: list[bytes],
+    payloads: list[bytes],
+    signatures: list[bytes],
+) -> VerifyJob | None:
+    """Async :func:`ed25519_verify_batch` (collect() -> uint8 codes)."""
+    lib = _load()
+    if lib is None:
+        return None
+    return _submit_batch(
+        lib,
+        lib.hg_ed25519_verify_batch_submit,
+        (_joined_u8(pubs), _joined_u8(signatures)),
+        payloads,
+        len(pubs),
+    )

@@ -43,6 +43,8 @@ hashgraph_proposals_created_total               counter    engine registration
 hashgraph_decisions_total                       counter    engine transitions
 hashgraph_timeouts_fired_total                  counter    engine timeout paths
 hashgraph_verify_cache_{hits,misses,negative_hits,evictions}_total  counter  VerifiedVoteCache (memoized admission)
+hashgraph_verified_signatures_total (+ {scheme=...})  counter    engine verify prepass (cache hits excluded)
+hashgraph_verify_pool_queue_depth               gauge      native verify-pool backlog (scrape-time)
 bridge_requests_total / bridge_errors_total     counter    bridge dispatch loop
 flight_dumps_total                              counter    flight recorder dump sites
 wal_checkpoints_total                           counter    DurableEngine checkpoints
@@ -134,6 +136,12 @@ VERIFY_CACHE_HITS_TOTAL = "hashgraph_verify_cache_hits_total"
 VERIFY_CACHE_MISSES_TOTAL = "hashgraph_verify_cache_misses_total"
 VERIFY_CACHE_NEGATIVE_HITS_TOTAL = "hashgraph_verify_cache_negative_hits_total"
 VERIFY_CACHE_EVICTIONS_TOTAL = "hashgraph_verify_cache_evictions_total"
+# Signatures handed to a scheme's (batch) verify — cache hits excluded.
+# Engines add a per-scheme labelled variant, e.g.
+# hashgraph_verified_signatures_total{scheme="Ed25519ConsensusSigner"}.
+VERIFIED_SIGNATURES_TOTAL = "hashgraph_verified_signatures_total"
+# Native verify-pool tasks queued + running, sampled at scrape time.
+VERIFY_POOL_QUEUE_DEPTH = "hashgraph_verify_pool_queue_depth"
 BUILD_INFO = "hashgraph_build_info"
 # Device/XLA telemetry (providers installed by install_jax_telemetry —
 # called from engine construction so obs itself stays jax-free).
@@ -165,6 +173,7 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         WAL_SEGMENT_COUNT,
         WAL_SEGMENT_BYTES,
         JAX_LIVE_BUFFER_BYTES,
+        VERIFY_POOL_QUEUE_DEPTH,
         TRACKED_PEERS,
         EVIDENCE_RECORDS,
         STALE_PEERS,
@@ -184,6 +193,7 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         VERIFY_CACHE_MISSES_TOTAL,
         VERIFY_CACHE_NEGATIVE_HITS_TOTAL,
         VERIFY_CACHE_EVICTIONS_TOTAL,
+        VERIFIED_SIGNATURES_TOTAL,
         ALERTS_TOTAL,
         EQUIVOCATIONS_TOTAL,
         FORK_REDELIVERIES_TOTAL,
@@ -283,6 +293,25 @@ def _jax_live_buffer_bytes() -> int:
 
 
 registry.register_gauge(JAX_LIVE_BUFFER_BYTES, _jax_live_buffer_bytes)
+
+
+def _verify_pool_queue_depth() -> int:
+    """Native verify-pool backlog — sampled at scrape time, and ONLY
+    when the runtime is already loaded: naming the gauge must never be
+    the thing that compiles or dlopens the native library (same
+    discipline as the JAX gauges above)."""
+    import sys
+
+    native = sys.modules.get("hashgraph_tpu.native")
+    if native is None:
+        return 0
+    try:
+        return native.pool_queue_depth_if_loaded()
+    except Exception:
+        return 0
+
+
+registry.register_gauge(VERIFY_POOL_QUEUE_DEPTH, _verify_pool_queue_depth)
 
 _jax_telemetry_installed = False
 

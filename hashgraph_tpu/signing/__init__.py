@@ -19,9 +19,34 @@ from ..errors import ConsensusSchemeError
 __all__ = [
     "ConsensusSignatureScheme",
     "ConsensusSchemeError",
+    "Ed25519ConsensusSigner",
     "EthereumConsensusSigner",
+    "PendingVerdicts",
     "StubConsensusSigner",
 ]
+
+
+class PendingVerdicts:
+    """Handle for an in-flight :meth:`~ConsensusSignatureScheme.verify_batch`.
+
+    ``collect()`` blocks until the batch resolves and returns exactly what
+    the synchronous call would have: one ``bool | ConsensusSchemeError``
+    per item. The default implementation simply defers the synchronous
+    batch to collect time; schemes with a native worker pool (Ethereum,
+    Ed25519) wrap an async submission instead, so the crypto runs on
+    background threads — GIL-free — between submit and collect. Collect
+    is idempotent; the first call does the waiting.
+    """
+
+    def __init__(self, collect_fn):
+        self._collect_fn = collect_fn
+        self._result = None
+
+    def collect(self) -> "list[bool | ConsensusSchemeError]":
+        if self._collect_fn is not None:
+            self._result = self._collect_fn()
+            self._collect_fn = None
+        return self._result
 
 
 class ConsensusSignatureScheme(abc.ABC):
@@ -65,6 +90,25 @@ class ConsensusSignatureScheme(abc.ABC):
                 out.append(exc)
         return out
 
+    @classmethod
+    def verify_batch_submit(
+        cls,
+        identities: list[bytes],
+        payloads: list[bytes],
+        signatures: list[bytes],
+    ) -> PendingVerdicts:
+        """Asynchronous :meth:`verify_batch` for the pipelined ingest
+        path: returns immediately; ``collect()`` yields the identical
+        verdict list. The default defers the synchronous batch to
+        collect time (observationally identical — verdicts are values,
+        never raises), so every scheme is pipeline-compatible; schemes
+        backed by the native worker pool override this to start the
+        crypto NOW and overlap it with device work."""
+        return PendingVerdicts(
+            lambda: cls.verify_batch(identities, payloads, signatures)
+        )
 
+
+from .ed25519 import Ed25519ConsensusSigner  # noqa: E402
 from .ethereum import EthereumConsensusSigner  # noqa: E402
 from .stub import StubConsensusSigner  # noqa: E402

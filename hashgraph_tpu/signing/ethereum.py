@@ -14,7 +14,7 @@ import secrets
 
 from ..errors import ConsensusSchemeError
 from .. import native
-from . import ConsensusSignatureScheme
+from . import ConsensusSignatureScheme, PendingVerdicts
 from ._keccak import keccak256
 from ._secp256k1 import N, pubkey_from_private, recover_pubkey, sign_recoverable
 
@@ -110,17 +110,18 @@ class EthereumConsensusSigner(ConsensusSignatureScheme):
         return address_from_pubkey(pubkey) == bytes(identity)
 
     @classmethod
-    def verify_batch(
+    def _precheck(
         cls,
         identities: list[bytes],
         payloads: list[bytes],
         signatures: list[bytes],
-    ) -> list[bool | ConsensusSchemeError]:
-        """Native threaded batch verification (GIL released for the whole
-        batch); falls back to the scalar loop without the native runtime."""
+    ) -> "tuple[list, list[int]]":
+        """Length gauntlet shared by the sync and async batch paths:
+        returns (out list with scheme errors pre-filled, well-formed row
+        indices). zip() truncation keeps the base-class contract for
+        ragged inputs."""
         well_formed: list[int] = []
         out: list[bool | ConsensusSchemeError] = []
-        # zip() truncation keeps the base-class contract for ragged inputs.
         for i, (identity, _payload, signature) in enumerate(
             zip(identities, payloads, signatures)
         ):
@@ -141,6 +142,18 @@ class EthereumConsensusSigner(ConsensusSignatureScheme):
             else:
                 out.append(False)  # placeholder
                 well_formed.append(i)
+        return out, well_formed
+
+    @classmethod
+    def verify_batch(
+        cls,
+        identities: list[bytes],
+        payloads: list[bytes],
+        signatures: list[bytes],
+    ) -> list[bool | ConsensusSchemeError]:
+        """Native threaded batch verification (GIL released for the whole
+        batch); falls back to the scalar loop without the native runtime."""
+        out, well_formed = cls._precheck(identities, payloads, signatures)
         if not well_formed:
             return out
         results = native.eth_verify_batch(
@@ -155,6 +168,13 @@ class EthereumConsensusSigner(ConsensusSignatureScheme):
                 except ConsensusSchemeError as exc:
                     out[i] = exc
             return out
+        cls._fan_out_codes(out, well_formed, results, signatures)
+        return out
+
+    @staticmethod
+    def _fan_out_codes(out, well_formed, results, signatures) -> None:
+        """Map native result codes onto the verdict list (shared by the
+        sync and async batch paths)."""
         for i, code in zip(well_formed, results):
             if code == 1:
                 out[i] = True
@@ -166,4 +186,34 @@ class EthereumConsensusSigner(ConsensusSignatureScheme):
                 out[i] = ConsensusSchemeError.verify(
                     f"invalid recovery id byte: {signatures[i][64]}"
                 )
-        return out
+
+    @classmethod
+    def verify_batch_submit(
+        cls,
+        identities: list[bytes],
+        payloads: list[bytes],
+        signatures: list[bytes],
+    ) -> PendingVerdicts:
+        """Async :meth:`verify_batch` on the persistent native pool:
+        returns immediately, the ECDSA runs GIL-free on worker threads,
+        and ``collect()`` fans out the identical verdicts. Degrades to
+        the deferred-sync default without the native runtime."""
+        out, well_formed = cls._precheck(identities, payloads, signatures)
+        job = (
+            native.eth_verify_batch_submit(
+                [bytes(identities[i]) for i in well_formed],
+                [payloads[i] for i in well_formed],
+                [signatures[i] for i in well_formed],
+            )
+            if well_formed
+            else None
+        )
+        if well_formed and job is None:
+            return super().verify_batch_submit(identities, payloads, signatures)
+
+        def _collect():
+            if job is not None:
+                cls._fan_out_codes(out, well_formed, job.collect(), signatures)
+            return out
+
+        return PendingVerdicts(_collect)

@@ -441,6 +441,29 @@ class DurableEngine:
             )
             return self._engine.ingest_votes(items, now, pre_validated=pre_validated)
 
+    def ingest_votes_pipelined(self, batches, now, pre_validated=False):
+        """Durable :meth:`TpuConsensusEngine.ingest_votes_pipelined`: one
+        KIND_VOTES record per batch, all logged IN ORDER before any batch
+        applies (log-before-ack at the granularity of the whole pipelined
+        call — statuses are not returned until every batch applied, so a
+        crash replays exactly the batch sequence the caller would have
+        been acked for, and replay runs them as plain sequential
+        ingest_votes calls, which the pipelined path is result-identical
+        to by contract)."""
+        with self._lock:
+            batches = [list(b) for b in batches]
+            for items in batches:
+                self._append_split(
+                    F.KIND_VOTES,
+                    [(scope, vote.encode()) for scope, vote in items],
+                    lambda its: F.encode_votes(now, pre_validated, its),
+                    F.VOTES_LEAD_BYTES,
+                    F.sizeof_vote_item,
+                )
+            return self._engine.ingest_votes_pipelined(
+                batches, now, pre_validated=pre_validated
+            )
+
     def ingest_columnar(
         self,
         scope,

@@ -17,11 +17,20 @@
 // Build: native/build.sh (g++ -O3 -shared). The Python wrapper falls back to
 // the pure-Python implementations when the shared object is absent.
 
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <deque>
+#include <functional>
+#include <memory>
 #include <mutex>
+#include <random>
+#include <sched.h>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 // ───────────────────────────── SHA-256 ─────────────────────────────
@@ -1175,27 +1184,1401 @@ static int eth_verify_one(const uint8_t identity[20], const uint8_t* payload,
   return memcmp(addr, identity, 20) == 0 ? 1 : 0;
 }
 
-// ─────────────────────── batch fan-out helper ──────────────────────
+// ─────────────────── persistent worker pool ────────────────────────
+// One process-wide pool of long-lived workers replaces the per-call
+// std::thread spawn the batch entry points used to pay (~100µs per
+// thread per call — measurable against sub-millisecond verify batches,
+// and fatal to pipelining, where submit must return immediately).
+// Every batch primitive fans its chunks here; the async submit/collect
+// pair (hg_*_submit / hg_pool_wait) additionally lets Python overlap
+// host crypto with device work: the workers never touch the GIL, so a
+// submitted batch runs while the interpreter drives the engine.
 
-// Split [0, count) across n_threads (0 = hardware concurrency); stay
-// single-threaded below min_parallel items where spawn cost dominates.
+class WorkerPool {
+ public:
+  static WorkerPool& instance() {
+    static WorkerPool* pool = new WorkerPool();  // leaked: workers may
+    return *pool;  // outlive static destruction order at process exit
+  }
+
+  // (Re)size the pool. Joins idle workers and spawns the new set; safe
+  // to call between batches (in-flight tasks finish on the old threads
+  // before they exit). n <= 0 restores the hardware default.
+  int configure(int n) {
+    std::vector<std::thread> old;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (n <= 0) n = default_threads();
+      stop_epoch_++;
+      old.swap(workers_);
+      cv_.notify_all();
+    }
+    for (auto& th : old) th.join();
+    std::lock_guard<std::mutex> lk(mu_);
+    target_ = n;
+    for (int i = 0; i < n; i++)
+      workers_.emplace_back([this, epoch = stop_epoch_] { loop(epoch); });
+    return n;
+  }
+
+  int size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    ensure_started_locked();
+    return (int)workers_.size();
+  }
+
+  // Tasks queued but not yet started, plus tasks currently running.
+  int64_t depth() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return (int64_t)queue_.size() + running_;
+  }
+
+  struct Job {
+    std::atomic<int64_t> remaining;
+    std::mutex mu;
+    std::condition_variable cv;
+    explicit Job(int64_t n) : remaining(n) {}
+  };
+
+  // Enqueue tasks under one shared completion job; returns it.
+  std::shared_ptr<Job> submit(std::vector<std::function<void()>> tasks) {
+    auto job = std::make_shared<Job>((int64_t)tasks.size());
+    if (tasks.empty()) return job;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      ensure_started_locked();
+      for (auto& t : tasks)
+        queue_.emplace_back([this, job, fn = std::move(t)] {
+          fn();
+          finish(*job);
+        });
+    }
+    cv_.notify_all();
+    return job;
+  }
+
+  // Block until the job completes. The CALLING thread participates in
+  // queue draining while it waits — a pool sized below the chunk count
+  // (or busy with another job) can never deadlock the waiter, and the
+  // caller's core is never idle while work is queued.
+  void wait(Job& job) {
+    while (job.remaining.load(std::memory_order_acquire) > 0) {
+      std::function<void()> task;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (!queue_.empty()) {
+          task = std::move(queue_.front());
+          queue_.pop_front();
+          running_++;
+        }
+      }
+      if (task) {
+        task();
+        std::lock_guard<std::mutex> lk(mu_);
+        running_--;
+        continue;
+      }
+      std::unique_lock<std::mutex> lk(job.mu);
+      job.cv.wait_for(lk, std::chrono::milliseconds(50), [&] {
+        return job.remaining.load(std::memory_order_acquire) <= 0;
+      });
+    }
+  }
+
+  // Async handle registry for the C ABI: ids are stable across the
+  // submit/collect round-trip through Python.
+  int64_t register_job(std::shared_ptr<Job> job) {
+    std::lock_guard<std::mutex> lk(handles_mu_);
+    int64_t id = next_handle_++;
+    handles_[id] = std::move(job);
+    return id;
+  }
+
+  int wait_handle(int64_t id) {
+    std::shared_ptr<Job> job;
+    {
+      std::lock_guard<std::mutex> lk(handles_mu_);
+      auto it = handles_.find(id);
+      if (it == handles_.end()) return 1;
+      job = it->second;
+      handles_.erase(it);
+    }
+    wait(*job);
+    return 0;
+  }
+
+ private:
+  WorkerPool() = default;
+
+  static int default_threads() {
+#ifdef __linux__
+    // Respect the AFFINITY mask, not the host's online-CPU count:
+    // hardware_concurrency() reports all online CPUs, so inside a
+    // cgroup/affinity-limited container (TPU-VM bench hosts) it would
+    // oversubscribe the few runnable cores with dozens of contending
+    // workers — the failure mode that capped the old per-call spawn
+    // path well below one core's worth of throughput.
+    cpu_set_t set;
+    if (sched_getaffinity(0, sizeof(set), &set) == 0) {
+      int n = CPU_COUNT(&set);
+      if (n >= 1) return n;
+    }
+#endif
+    int n = (int)std::thread::hardware_concurrency();
+    return n < 1 ? 1 : n;
+  }
+
+  void ensure_started_locked() {
+    if (workers_.empty() && target_ == 0) {
+      target_ = default_threads();
+      for (int i = 0; i < target_; i++)
+        workers_.emplace_back([this, epoch = stop_epoch_] { loop(epoch); });
+    }
+  }
+
+  void finish(Job& job) {
+    if (job.remaining.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      std::lock_guard<std::mutex> lk(job.mu);
+      job.cv.notify_all();
+    }
+  }
+
+  void loop(uint64_t epoch) {
+    for (;;) {
+      std::function<void()> task;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] {
+          return !queue_.empty() || stop_epoch_ != epoch;
+        });
+        if (queue_.empty()) return;  // epoch rolled: retire this worker
+        task = std::move(queue_.front());
+        queue_.pop_front();
+        running_++;
+      }
+      task();
+      std::lock_guard<std::mutex> lk(mu_);
+      running_--;
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::function<void()>> queue_;
+  std::vector<std::thread> workers_;
+  int64_t running_ = 0;
+  int target_ = 0;
+  uint64_t stop_epoch_ = 0;
+
+  std::mutex handles_mu_;
+  std::unordered_map<int64_t, std::shared_ptr<Job>> handles_;
+  int64_t next_handle_ = 1;
+};
+
+// Split [0, count) into per-worker chunks on the persistent pool (0 =
+// pool width); stay single-threaded below min_parallel items where even
+// queue traffic dominates. The calling thread runs the first chunk
+// itself and then drains the queue alongside the workers.
 template <typename Work>
 static void run_parallel(int64_t count, int n_threads, int64_t min_parallel,
                          const Work& work) {
-  if (n_threads <= 0) n_threads = (int)std::thread::hardware_concurrency();
+  WorkerPool& pool = WorkerPool::instance();
+  if (n_threads <= 0) n_threads = pool.size();
   if (n_threads < 1) n_threads = 1;
   if (n_threads == 1 || count < min_parallel) {
     work(0, count);
     return;
   }
-  std::vector<std::thread> threads;
   int64_t chunk = (count + n_threads - 1) / n_threads;
-  for (int t = 0; t < n_threads; t++) {
+  std::vector<std::function<void()>> tasks;
+  for (int t = 1; t < n_threads; t++) {
     int64_t lo = t * chunk, hi = std::min<int64_t>(count, lo + chunk);
     if (lo >= hi) break;
-    threads.emplace_back(work, lo, hi);
+    tasks.emplace_back([&work, lo, hi] { work(lo, hi); });
   }
-  for (auto& th : threads) th.join();
+  auto job = pool.submit(std::move(tasks));
+  work(0, std::min<int64_t>(count, chunk));
+  pool.wait(*job);
+}
+
+// Chunked async fan-out: enqueue [0, count) as pool tasks WITHOUT
+// waiting; the returned handle blocks in hg_pool_wait. Chunks are
+// smaller than one-per-worker so late chunks load-balance across
+// whatever the pool is doing when they run.
+template <typename Work>
+static int64_t submit_parallel(int64_t count, int64_t min_chunk, Work work) {
+  WorkerPool& pool = WorkerPool::instance();
+  int64_t width = pool.size();
+  int64_t chunk = std::max<int64_t>(min_chunk, count / (4 * width) + 1);
+  std::vector<std::function<void()>> tasks;
+  for (int64_t lo = 0; lo < count; lo += chunk) {
+    int64_t hi = std::min<int64_t>(count, lo + chunk);
+    tasks.emplace_back([work, lo, hi] { work(lo, hi); });
+  }
+  return pool.register_job(pool.submit(std::move(tasks)));
+}
+
+// ───────────────────────────── SHA-512 ─────────────────────────────
+// Needed by Ed25519 (RFC 8032 hashes everything with SHA-512).
+
+static const uint64_t SHA512_K[80] = {
+    0x428a2f98d728ae22ULL, 0x7137449123ef65cdULL, 0xb5c0fbcfec4d3b2fULL, 0xe9b5dba58189dbbcULL,
+    0x3956c25bf348b538ULL, 0x59f111f1b605d019ULL, 0x923f82a4af194f9bULL, 0xab1c5ed5da6d8118ULL,
+    0xd807aa98a3030242ULL, 0x12835b0145706fbeULL, 0x243185be4ee4b28cULL, 0x550c7dc3d5ffb4e2ULL,
+    0x72be5d74f27b896fULL, 0x80deb1fe3b1696b1ULL, 0x9bdc06a725c71235ULL, 0xc19bf174cf692694ULL,
+    0xe49b69c19ef14ad2ULL, 0xefbe4786384f25e3ULL, 0x0fc19dc68b8cd5b5ULL, 0x240ca1cc77ac9c65ULL,
+    0x2de92c6f592b0275ULL, 0x4a7484aa6ea6e483ULL, 0x5cb0a9dcbd41fbd4ULL, 0x76f988da831153b5ULL,
+    0x983e5152ee66dfabULL, 0xa831c66d2db43210ULL, 0xb00327c898fb213fULL, 0xbf597fc7beef0ee4ULL,
+    0xc6e00bf33da88fc2ULL, 0xd5a79147930aa725ULL, 0x06ca6351e003826fULL, 0x142929670a0e6e70ULL,
+    0x27b70a8546d22ffcULL, 0x2e1b21385c26c926ULL, 0x4d2c6dfc5ac42aedULL, 0x53380d139d95b3dfULL,
+    0x650a73548baf63deULL, 0x766a0abb3c77b2a8ULL, 0x81c2c92e47edaee6ULL, 0x92722c851482353bULL,
+    0xa2bfe8a14cf10364ULL, 0xa81a664bbc423001ULL, 0xc24b8b70d0f89791ULL, 0xc76c51a30654be30ULL,
+    0xd192e819d6ef5218ULL, 0xd69906245565a910ULL, 0xf40e35855771202aULL, 0x106aa07032bbd1b8ULL,
+    0x19a4c116b8d2d0c8ULL, 0x1e376c085141ab53ULL, 0x2748774cdf8eeb99ULL, 0x34b0bcb5e19b48a8ULL,
+    0x391c0cb3c5c95a63ULL, 0x4ed8aa4ae3418acbULL, 0x5b9cca4f7763e373ULL, 0x682e6ff3d6b2b8a3ULL,
+    0x748f82ee5defb2fcULL, 0x78a5636f43172f60ULL, 0x84c87814a1f0ab72ULL, 0x8cc702081a6439ecULL,
+    0x90befffa23631e28ULL, 0xa4506cebde82bde9ULL, 0xbef9a3f7b2c67915ULL, 0xc67178f2e372532bULL,
+    0xca273eceea26619cULL, 0xd186b8c721c0c207ULL, 0xeada7dd6cde0eb1eULL, 0xf57d4f7fee6ed178ULL,
+    0x06f067aa72176fbaULL, 0x0a637dc5a2c898a6ULL, 0x113f9804bef90daeULL, 0x1b710b35131c471bULL,
+    0x28db77f523047d84ULL, 0x32caab7b40c72493ULL, 0x3c9ebe0a15c9bebcULL, 0x431d67c49c100d4cULL,
+    0x4cc5d4becb3e42b6ULL, 0x597f299cfc657e2aULL, 0x5fcb6fab3ad6faecULL, 0x6c44198c4a475817ULL};
+
+static inline uint64_t rotr64(uint64_t x, int n) {
+  return (x >> n) | (x << (64 - n));
+}
+
+static void sha512_compress(uint64_t h[8], const uint8_t block[128]) {
+  uint64_t w[80];
+  for (int i = 0; i < 16; i++) {
+    uint64_t v = 0;
+    for (int j = 0; j < 8; j++) v = (v << 8) | block[8 * i + j];
+    w[i] = v;
+  }
+  for (int i = 16; i < 80; i++) {
+    uint64_t s0 = rotr64(w[i - 15], 1) ^ rotr64(w[i - 15], 8) ^ (w[i - 15] >> 7);
+    uint64_t s1 = rotr64(w[i - 2], 19) ^ rotr64(w[i - 2], 61) ^ (w[i - 2] >> 6);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint64_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+           g = h[6], hh = h[7];
+  for (int i = 0; i < 80; i++) {
+    uint64_t S1 = rotr64(e, 14) ^ rotr64(e, 18) ^ rotr64(e, 41);
+    uint64_t ch = (e & f) ^ (~e & g);
+    uint64_t t1 = hh + S1 + ch + SHA512_K[i] + w[i];
+    uint64_t S0 = rotr64(a, 28) ^ rotr64(a, 34) ^ rotr64(a, 39);
+    uint64_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint64_t t2 = S0 + maj;
+    hh = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+// Streaming interface: Ed25519 hashes (R || A || M) without materialising
+// the concatenation.
+struct Sha512 {
+  uint64_t h[8] = {0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL,
+                   0x3c6ef372fe94f82bULL, 0xa54ff53a5f1d36f1ULL,
+                   0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+                   0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+  uint8_t buf[128];
+  size_t buffered = 0;
+  uint64_t total = 0;
+
+  void update(const uint8_t* data, size_t len) {
+    total += len;
+    if (buffered) {
+      size_t take = std::min(len, (size_t)128 - buffered);
+      memcpy(buf + buffered, data, take);
+      buffered += take;
+      data += take;
+      len -= take;
+      if (buffered == 128) {
+        sha512_compress(h, buf);
+        buffered = 0;
+      }
+    }
+    while (len >= 128) {
+      sha512_compress(h, data);
+      data += 128;
+      len -= 128;
+    }
+    if (len) {
+      memcpy(buf, data, len);
+      buffered = len;
+    }
+  }
+
+  void final(uint8_t out[64]) {
+    uint8_t pad[256] = {0};
+    memcpy(pad, buf, buffered);
+    pad[buffered] = 0x80;
+    size_t blocks = (buffered + 17 <= 128) ? 1 : 2;
+    uint64_t bits = total * 8;  // < 2^64 for any realistic payload
+    for (int i = 0; i < 8; i++)
+      pad[blocks * 128 - 1 - i] = uint8_t(bits >> (8 * i));
+    for (size_t b = 0; b < blocks; b++) sha512_compress(h, pad + 128 * b);
+    for (int i = 0; i < 8; i++)
+      for (int j = 0; j < 8; j++) out[8 * i + j] = uint8_t(h[i] >> (8 * (7 - j)));
+  }
+};
+
+// ─────────────── curve25519 field: radix-2^51 limbs ────────────────
+// p = 2^255 - 19. Unlike the secp256k1 section's canonical-every-op
+// 4x64 code, this uses the donna-style 5x51 representation with lazy
+// carries: limbs stay < 2^52 between operations, no compare/subtract
+// per op, and 2^255 ≡ 19 makes the product fold a single multiply-add
+// per limb. The Ed25519 hot path is pure mul/sq chains, so this is
+// where the batch-verify throughput comes from.
+
+typedef uint64_t fe25[5];
+typedef unsigned __int128 uint128_t;
+
+static const uint64_t M51 = 0x7FFFFFFFFFFFFULL;
+
+static void fe_copy(fe25 r, const fe25 a) { memcpy(r, a, sizeof(fe25)); }
+
+static void fe_0(fe25 r) { memset(r, 0, sizeof(fe25)); }
+
+static void fe_1(fe25 r) {
+  fe_0(r);
+  r[0] = 1;
+}
+
+// One sequential carry pass: limbs < 2^54 in, < 2^51 + tiny out.
+static inline void fe_carry(fe25 h) {
+  uint64_t c;
+  c = h[0] >> 51; h[0] &= M51; h[1] += c;
+  c = h[1] >> 51; h[1] &= M51; h[2] += c;
+  c = h[2] >> 51; h[2] &= M51; h[3] += c;
+  c = h[3] >> 51; h[3] &= M51; h[4] += c;
+  c = h[4] >> 51; h[4] &= M51; h[0] += 19 * c;
+}
+
+// Lazy (carry-free) add/sub, donna-style: limbs grow to < 2^54, which
+// fe_mul/fe_sq/fe_carry/fe_tobytes all tolerate. The point formulas
+// below are arranged so no operand ever chains more than two uncarried
+// add/subs before re-entering a multiply (which re-reduces), and every
+// fe_sub's subtrahend is < 2^53 limb-wise so adding 4p cannot underflow.
+static inline void fe_add(fe25 r, const fe25 a, const fe25 b) {
+  for (int i = 0; i < 5; i++) r[i] = a[i] + b[i];
+}
+
+static inline void fe_sub(fe25 r, const fe25 a, const fe25 b) {
+  r[0] = a[0] + 0x1FFFFFFFFFFFB4ULL - b[0];
+  r[1] = a[1] + 0x1FFFFFFFFFFFFCULL - b[1];
+  r[2] = a[2] + 0x1FFFFFFFFFFFFCULL - b[2];
+  r[3] = a[3] + 0x1FFFFFFFFFFFFCULL - b[3];
+  r[4] = a[4] + 0x1FFFFFFFFFFFFCULL - b[4];
+}
+
+static inline void fe_neg(fe25 r, const fe25 a) {
+  fe25 zero;
+  fe_0(zero);
+  fe_sub(r, zero, a);
+}
+
+static void fe_mul(fe25 r, const fe25 f, const fe25 g) {
+  uint64_t f0 = f[0], f1 = f[1], f2 = f[2], f3 = f[3], f4 = f[4];
+  uint64_t g0 = g[0], g1 = g[1], g2 = g[2], g3 = g[3], g4 = g[4];
+  uint64_t g1_19 = 19 * g1, g2_19 = 19 * g2, g3_19 = 19 * g3, g4_19 = 19 * g4;
+  uint128_t t0 = (uint128_t)f0 * g0 + (uint128_t)f1 * g4_19 +
+                 (uint128_t)f2 * g3_19 + (uint128_t)f3 * g2_19 +
+                 (uint128_t)f4 * g1_19;
+  uint128_t t1 = (uint128_t)f0 * g1 + (uint128_t)f1 * g0 +
+                 (uint128_t)f2 * g4_19 + (uint128_t)f3 * g3_19 +
+                 (uint128_t)f4 * g2_19;
+  uint128_t t2 = (uint128_t)f0 * g2 + (uint128_t)f1 * g1 +
+                 (uint128_t)f2 * g0 + (uint128_t)f3 * g4_19 +
+                 (uint128_t)f4 * g3_19;
+  uint128_t t3 = (uint128_t)f0 * g3 + (uint128_t)f1 * g2 +
+                 (uint128_t)f2 * g1 + (uint128_t)f3 * g0 +
+                 (uint128_t)f4 * g4_19;
+  uint128_t t4 = (uint128_t)f0 * g4 + (uint128_t)f1 * g3 +
+                 (uint128_t)f2 * g2 + (uint128_t)f3 * g1 +
+                 (uint128_t)f4 * g0;
+  uint64_t r0 = (uint64_t)t0 & M51; t1 += (uint64_t)(t0 >> 51);
+  uint64_t r1 = (uint64_t)t1 & M51; t2 += (uint64_t)(t1 >> 51);
+  uint64_t r2 = (uint64_t)t2 & M51; t3 += (uint64_t)(t2 >> 51);
+  uint64_t r3 = (uint64_t)t3 & M51; t4 += (uint64_t)(t3 >> 51);
+  uint64_t r4 = (uint64_t)t4 & M51;
+  r0 += 19 * (uint64_t)(t4 >> 51);
+  r1 += r0 >> 51; r0 &= M51;
+  r[0] = r0; r[1] = r1; r[2] = r2; r[3] = r3; r[4] = r4;
+}
+
+static void fe_sq(fe25 r, const fe25 f) {
+  uint64_t f0 = f[0], f1 = f[1], f2 = f[2], f3 = f[3], f4 = f[4];
+  uint64_t f0_2 = 2 * f0, f1_2 = 2 * f1;
+  uint64_t f1_38 = 38 * f1, f2_38 = 38 * f2, f3_38 = 38 * f3;
+  uint64_t f3_19 = 19 * f3, f4_19 = 19 * f4;
+  uint128_t t0 = (uint128_t)f0 * f0 + (uint128_t)f1_38 * f4 +
+                 (uint128_t)f2_38 * f3;
+  uint128_t t1 = (uint128_t)f0_2 * f1 + (uint128_t)f2_38 * f4 +
+                 (uint128_t)f3_19 * f3;
+  uint128_t t2 = (uint128_t)f0_2 * f2 + (uint128_t)f1 * f1 +
+                 (uint128_t)f3_38 * f4;
+  uint128_t t3 = (uint128_t)f0_2 * f3 + (uint128_t)f1_2 * f2 +
+                 (uint128_t)f4_19 * f4;
+  uint128_t t4 = (uint128_t)f0_2 * f4 + (uint128_t)f1_2 * f3 +
+                 (uint128_t)f2 * f2;
+  uint64_t r0 = (uint64_t)t0 & M51; t1 += (uint64_t)(t0 >> 51);
+  uint64_t r1 = (uint64_t)t1 & M51; t2 += (uint64_t)(t1 >> 51);
+  uint64_t r2 = (uint64_t)t2 & M51; t3 += (uint64_t)(t2 >> 51);
+  uint64_t r3 = (uint64_t)t3 & M51; t4 += (uint64_t)(t3 >> 51);
+  uint64_t r4 = (uint64_t)t4 & M51;
+  r0 += 19 * (uint64_t)(t4 >> 51);
+  r1 += r0 >> 51; r0 &= M51;
+  r[0] = r0; r[1] = r1; r[2] = r2; r[3] = r3; r[4] = r4;
+}
+
+static void fe_sqn(fe25 r, const fe25 a, int n) {
+  fe_sq(r, a);
+  for (int i = 1; i < n; i++) fe_sq(r, r);
+}
+
+static inline uint64_t load64_le(const uint8_t* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;  // little-endian host assumed, as in keccak256 above
+}
+
+static inline void store64_le(uint8_t* p, uint64_t v) { memcpy(p, &v, 8); }
+
+static void fe_frombytes(fe25 h, const uint8_t s[32]) {
+  uint64_t a0 = load64_le(s), a1 = load64_le(s + 8), a2 = load64_le(s + 16),
+           a3 = load64_le(s + 24);
+  h[0] = a0 & M51;
+  h[1] = ((a0 >> 51) | (a1 << 13)) & M51;
+  h[2] = ((a1 >> 38) | (a2 << 26)) & M51;
+  h[3] = ((a2 >> 25) | (a3 << 39)) & M51;
+  h[4] = (a3 >> 12) & M51;  // bit 255 (the sign bit slot) dropped
+}
+
+static void fe_tobytes(uint8_t s[32], const fe25 f) {
+  fe25 h;
+  fe_copy(h, f);
+  fe_carry(h);
+  fe_carry(h);
+  // Canonicalize: add 19 and see whether that wraps past 2^255; if so
+  // the value was >= p and needs the fold applied for real.
+  uint64_t q = (h[0] + 19) >> 51;
+  q = (h[1] + q) >> 51;
+  q = (h[2] + q) >> 51;
+  q = (h[3] + q) >> 51;
+  q = (h[4] + q) >> 51;
+  h[0] += 19 * q;
+  h[1] += h[0] >> 51; h[0] &= M51;
+  h[2] += h[1] >> 51; h[1] &= M51;
+  h[3] += h[2] >> 51; h[2] &= M51;
+  h[4] += h[3] >> 51; h[3] &= M51;
+  h[4] &= M51;
+  store64_le(s, h[0] | (h[1] << 51));
+  store64_le(s + 8, (h[1] >> 13) | (h[2] << 38));
+  store64_le(s + 16, (h[2] >> 26) | (h[3] << 25));
+  store64_le(s + 24, (h[3] >> 39) | (h[4] << 12));
+}
+
+static bool fe_iszero(const fe25 f) {
+  uint8_t s[32];
+  fe_tobytes(s, f);
+  uint8_t acc = 0;
+  for (int i = 0; i < 32; i++) acc |= s[i];
+  return acc == 0;
+}
+
+static bool fe_eq(const fe25 a, const fe25 b) {
+  uint8_t sa[32], sb[32];
+  fe_tobytes(sa, a);
+  fe_tobytes(sb, b);
+  return memcmp(sa, sb, 32) == 0;
+}
+
+static int fe_isnegative(const fe25 f) {
+  uint8_t s[32];
+  fe_tobytes(s, f);
+  return s[0] & 1;
+}
+
+// Interleaved squaring over a group of independent elements: one fe_sq
+// is a ~254-deep dependency chain in the exponent towers below, so
+// stepping a group of 4 states per squaring lets the CPU overlap their
+// multiply latencies (~1.7x on batched decompression).
+static void fe_sq_each(fe25* x, int cnt, int n) {
+  for (int s = 0; s < n; s++)
+    for (int k = 0; k < cnt; k++) fe_sq(x[k], x[k]);
+}
+
+// Batched z^(2^252 - 3) (the sqrt helper exponent): the fe_pow22523
+// chain with every step applied to ``cnt`` independent inputs.
+static constexpr int FE_POW_GROUP = 4;
+
+static void fe_pow22523_multi(fe25* r, const fe25* z, int cnt) {
+  fe25 t0[FE_POW_GROUP], t1[FE_POW_GROUP], t2[FE_POW_GROUP];
+  for (int k = 0; k < cnt; k++) {
+    fe_sq(t0[k], z[k]);                    // 2
+    fe_sq(t1[k], t0[k]);
+    fe_sq(t1[k], t1[k]);                   // 8
+    fe_mul(t1[k], z[k], t1[k]);            // 9
+    fe_mul(t0[k], t0[k], t1[k]);           // 11
+    fe_sq(t0[k], t0[k]);                   // 22
+    fe_mul(t0[k], t1[k], t0[k]);           // 31 = 2^5 - 1
+  }
+  for (int k = 0; k < cnt; k++) fe_copy(t1[k], t0[k]);
+  fe_sq_each(t1, cnt, 5);
+  for (int k = 0; k < cnt; k++) fe_mul(t0[k], t1[k], t0[k]);  // 2^10 - 1
+  for (int k = 0; k < cnt; k++) fe_copy(t1[k], t0[k]);
+  fe_sq_each(t1, cnt, 10);
+  for (int k = 0; k < cnt; k++) fe_mul(t1[k], t1[k], t0[k]);  // 2^20 - 1
+  for (int k = 0; k < cnt; k++) fe_copy(t2[k], t1[k]);
+  fe_sq_each(t2, cnt, 20);
+  for (int k = 0; k < cnt; k++) fe_mul(t1[k], t2[k], t1[k]);  // 2^40 - 1
+  fe_sq_each(t1, cnt, 10);
+  for (int k = 0; k < cnt; k++) fe_mul(t0[k], t1[k], t0[k]);  // 2^50 - 1
+  for (int k = 0; k < cnt; k++) fe_copy(t1[k], t0[k]);
+  fe_sq_each(t1, cnt, 50);
+  for (int k = 0; k < cnt; k++) fe_mul(t1[k], t1[k], t0[k]);  // 2^100 - 1
+  for (int k = 0; k < cnt; k++) fe_copy(t2[k], t1[k]);
+  fe_sq_each(t2, cnt, 100);
+  for (int k = 0; k < cnt; k++) fe_mul(t1[k], t2[k], t1[k]);  // 2^200 - 1
+  fe_sq_each(t1, cnt, 50);
+  for (int k = 0; k < cnt; k++) fe_mul(t0[k], t1[k], t0[k]);  // 2^250 - 1
+  fe_sq_each(t0, cnt, 2);                                     // 2^252 - 4
+  for (int k = 0; k < cnt; k++) fe_mul(r[k], t0[k], z[k]);    // 2^252 - 3
+}
+
+// z^(2^250 - 1) — the shared tower of both exponent chains below.
+static void fe_pow250_1(fe25 out, fe25 z11_out, const fe25 z) {
+  fe25 t0, t1, t2;
+  fe_sq(t0, z);                    // 2
+  fe_sqn(t1, t0, 2);               // 8
+  fe_mul(t1, z, t1);               // 9
+  fe_mul(t0, t0, t1);              // 11
+  fe_copy(z11_out, t0);
+  fe_sq(t0, t0);                   // 22
+  fe_mul(t0, t1, t0);              // 31 = 2^5 - 1
+  fe_sqn(t1, t0, 5);
+  fe_mul(t0, t1, t0);              // 2^10 - 1
+  fe_sqn(t1, t0, 10);
+  fe_mul(t1, t1, t0);              // 2^20 - 1
+  fe_sqn(t2, t1, 20);
+  fe_mul(t1, t2, t1);              // 2^40 - 1
+  fe_sqn(t1, t1, 10);
+  fe_mul(t0, t1, t0);              // 2^50 - 1
+  fe_sqn(t1, t0, 50);
+  fe_mul(t1, t1, t0);              // 2^100 - 1
+  fe_sqn(t2, t1, 100);
+  fe_mul(t1, t2, t1);              // 2^200 - 1
+  fe_sqn(t1, t1, 50);
+  fe_mul(out, t1, t0);             // 2^250 - 1
+}
+
+// z^(p - 2) = z^(2^255 - 21): the modular inverse.
+static void fe_invert(fe25 r, const fe25 z) {
+  fe25 t, z11;
+  fe_pow250_1(t, z11, z);
+  fe_sqn(t, t, 5);                 // 2^255 - 2^5
+  fe_mul(r, t, z11);               // 2^255 - 32 + 11 = 2^255 - 21
+}
+
+// z^((p - 5) / 8) = z^(2^252 - 3): the square-root helper exponent.
+static void fe_pow22523(fe25 r, const fe25 z) {
+  fe25 t, z11;
+  fe_pow250_1(t, z11, z);
+  fe_sqn(t, t, 2);                 // 2^252 - 4
+  fe_mul(r, t, z);                 // 2^252 - 3
+}
+
+// Montgomery batch inversion over fe25 (same trick as fp_batch_inv):
+// zeros are left untouched.
+static void fe_batch_invert(fe25* vals, int n) {
+  std::vector<uint64_t> prefix((size_t)n * 5);
+  fe25 acc;
+  fe_1(acc);
+  for (int i = 0; i < n; i++) {
+    memcpy(&prefix[(size_t)i * 5], acc, sizeof(fe25));
+    if (!fe_iszero(vals[i])) fe_mul(acc, acc, vals[i]);
+  }
+  fe25 inv;
+  fe_invert(inv, acc);
+  for (int i = n - 1; i >= 0; i--) {
+    if (fe_iszero(vals[i])) continue;
+    fe25 orig;
+    fe_copy(orig, vals[i]);
+    fe_mul(vals[i], inv, (const uint64_t*)&prefix[(size_t)i * 5]);
+    fe_mul(inv, inv, orig);
+  }
+}
+
+// Curve constants (radix-51).
+static const fe25 ED_D = {0x34DCA135978A3ULL, 0x1A8283B156EBDULL,
+                          0x5E7A26001C029ULL, 0x739C663A03CBBULL,
+                          0x52036CEE2B6FFULL};
+static const fe25 ED_2D = {0x69B9426B2F159ULL, 0x35050762ADD7AULL,
+                           0x3CF44C0038052ULL, 0x6738CC7407977ULL,
+                           0x2406D9DC56DFFULL};
+static const fe25 ED_SQRTM1 = {0x61B274A0EA0B0ULL, 0x0D5A5FC8F189DULL,
+                               0x7EF5E9CBD0C60ULL, 0x78595A6804C9EULL,
+                               0x2B8324804FC1DULL};
+static const fe25 ED_BX = {0x62D608F25D51AULL, 0x412A4B4F6592AULL,
+                           0x75B7171A4B31DULL, 0x1FF60527118FEULL,
+                           0x216936D3CD6E5ULL};
+static const fe25 ED_BY = {0x6666666666658ULL, 0x4CCCCCCCCCCCCULL,
+                           0x1999999999999ULL, 0x3333333333333ULL,
+                           0x6666666666666ULL};
+
+// ───────────── Edwards points (extended coordinates) ───────────────
+
+struct GeP3 {
+  fe25 X, Y, Z, T;  // x = X/Z, y = Y/Z, T = XY/Z
+};
+
+// Affine precomputed form for the fixed-base table: (y+x, y-x, 2d·x·y).
+struct GeNiels {
+  fe25 ypx, ymx, xy2d;
+};
+
+static void ge_identity(GeP3& r) {
+  fe_0(r.X);
+  fe_1(r.Y);
+  fe_1(r.Z);
+  fe_0(r.T);
+}
+
+// Unified addition (add-2008-hwcd-3 for a = -1): ~8 muls.
+static void ge_add(GeP3& r, const GeP3& p, const GeP3& q) {
+  fe25 a, b, c, d, e, f, g, h, t1, t2;
+  fe_sub(t1, p.Y, p.X);
+  fe_sub(t2, q.Y, q.X);
+  fe_mul(a, t1, t2);
+  fe_add(t1, p.Y, p.X);
+  fe_add(t2, q.Y, q.X);
+  fe_mul(b, t1, t2);
+  fe_mul(c, p.T, q.T);
+  fe_mul(c, c, ED_2D);
+  fe_mul(d, p.Z, q.Z);
+  fe_add(d, d, d);
+  fe_sub(e, b, a);
+  fe_sub(f, d, c);
+  fe_add(g, d, c);
+  fe_add(h, b, a);
+  fe_mul(r.X, e, f);
+  fe_mul(r.Y, g, h);
+  fe_mul(r.T, e, h);
+  fe_mul(r.Z, f, g);
+}
+
+// Mixed addition with an affine Niels point: saves the Z multiply.
+static void ge_madd(GeP3& r, const GeP3& p, const GeNiels& q) {
+  fe25 a, b, c, d, e, f, g, h, t1;
+  fe_sub(t1, p.Y, p.X);
+  fe_mul(a, t1, q.ymx);
+  fe_add(t1, p.Y, p.X);
+  fe_mul(b, t1, q.ypx);
+  fe_mul(c, p.T, q.xy2d);
+  fe_add(d, p.Z, p.Z);
+  fe_sub(e, b, a);
+  fe_sub(f, d, c);
+  fe_add(g, d, c);
+  fe_add(h, b, a);
+  fe_mul(r.X, e, f);
+  fe_mul(r.Y, g, h);
+  fe_mul(r.T, e, h);
+  fe_mul(r.Z, f, g);
+}
+
+// Doubling (dbl-2008-hwcd, all four outputs negated — an equivalent
+// projective representative — so no field negation is needed).
+static void ge_dbl(GeP3& r, const GeP3& p) {
+  fe25 a, b, c, e, f, g, h, t;
+  fe_sq(a, p.X);
+  fe_sq(b, p.Y);
+  fe_sq(c, p.Z);
+  fe_add(c, c, c);
+  fe_add(h, a, b);
+  fe_add(t, p.X, p.Y);
+  fe_sq(t, t);
+  fe_sub(e, t, h);     // 2XY (h < 2^53 limb-wise: sum of two squarings)
+  fe_sub(g, b, a);     // Y² - X²
+  fe_add(t, c, a);     // f = 2Z² - (Y²-X²) computed as (2Z²+X²) - Y² so
+  fe_sub(f, t, b);     // the lazy sub's subtrahend stays reduced
+  fe_mul(r.X, e, f);
+  fe_mul(r.Y, g, h);
+  fe_mul(r.T, e, h);
+  fe_mul(r.Z, f, g);
+}
+
+static void ge_neg(GeP3& r, const GeP3& p) {
+  fe_neg(r.X, p.X);
+  fe_copy(r.Y, p.Y);
+  fe_copy(r.Z, p.Z);
+  fe_neg(r.T, p.T);
+}
+
+static bool ge_is_identity(const GeP3& p) {
+  // x = 0 and y = z (y/z = 1).
+  return fe_iszero(p.X) && fe_eq(p.Y, p.Z);
+}
+
+static void ge_tobytes(uint8_t s[32], const GeP3& p) {
+  fe25 zi, x, y;
+  fe_invert(zi, p.Z);
+  fe_mul(x, p.X, zi);
+  fe_mul(y, p.Y, zi);
+  fe_tobytes(s, y);
+  s[31] ^= uint8_t(fe_isnegative(x) << 7);
+}
+
+// Decompress a point: y from the low 255 bits, x = ±sqrt((y²-1)/(dy²+1)).
+// Rejects non-canonical y (>= p), off-curve x, and x = 0 with the sign
+// bit set (RFC 8032 §5.1.3 decoding).
+static bool ge_frombytes(GeP3& r, const uint8_t s[32]) {
+  // Canonical-encoding check: re-serializing the decoded y must give the
+  // same 255 bits back.
+  fe25 y;
+  fe_frombytes(y, s);
+  uint8_t canon[32];
+  fe_tobytes(canon, y);
+  for (int i = 0; i < 31; i++)
+    if (canon[i] != s[i]) return false;
+  if ((canon[31] & 0x7F) != (s[31] & 0x7F)) return false;
+
+  fe25 yy, u, v, x, xx, t;
+  fe_sq(yy, y);
+  fe25 one;
+  fe_1(one);
+  fe_sub(u, yy, one);        // y² - 1
+  fe_carry(u);               // u feeds fe_neg below: keep it reduced
+  fe_mul(v, yy, ED_D);
+  fe_add(v, v, one);         // d·y² + 1
+  // x = u·v³·(u·v⁷)^((p-5)/8)
+  fe25 v3, v7, p1;
+  fe_sq(v3, v);
+  fe_mul(v3, v3, v);         // v³
+  fe_sq(v7, v3);
+  fe_mul(v7, v7, v);         // v⁷
+  fe_mul(p1, u, v7);
+  fe_pow22523(p1, p1);
+  fe_mul(x, u, v3);
+  fe_mul(x, x, p1);
+  // check v·x² against ±u
+  fe_sq(xx, x);
+  fe_mul(xx, xx, v);
+  fe25 neg_u;
+  fe_neg(neg_u, u);
+  if (fe_eq(xx, u)) {
+    // x is the root
+  } else if (fe_eq(xx, neg_u)) {
+    fe_mul(x, x, ED_SQRTM1);
+  } else {
+    return false;
+  }
+  int sign = (s[31] >> 7) & 1;
+  if (fe_iszero(x)) {
+    if (sign) return false;  // -0 is not a valid encoding
+  } else if (fe_isnegative(x) != sign) {
+    fe_neg(x, x);
+  }
+  fe_copy(r.X, x);
+  fe_copy(r.Y, y);
+  fe_1(r.Z);
+  fe_mul(r.T, x, y);
+  (void)t;
+  return true;
+}
+
+// Batched decompression: identical acceptance rules to ge_frombytes,
+// but the ~254-squaring sqrt exponent chains of up to FE_POW_GROUP
+// points run interleaved (fe_pow22523_multi) — decompression is the
+// single largest per-signature cost of batch verification, and it is
+// latency-bound, not throughput-bound.
+static void ge_frombytes_multi(GeP3* out, uint8_t* ok,
+                               const uint8_t* const* encs, int count) {
+  for (int base = 0; base < count; base += FE_POW_GROUP) {
+    int cnt = std::min(FE_POW_GROUP, count - base);
+    fe25 y[FE_POW_GROUP], u[FE_POW_GROUP], v[FE_POW_GROUP];
+    fe25 v3[FE_POW_GROUP], pin[FE_POW_GROUP], p1[FE_POW_GROUP];
+    bool pre_ok[FE_POW_GROUP];
+    for (int k = 0; k < cnt; k++) {
+      const uint8_t* s = encs[base + k];
+      fe_frombytes(y[k], s);
+      uint8_t canon[32];
+      fe_tobytes(canon, y[k]);
+      pre_ok[k] = memcmp(canon, s, 31) == 0 &&
+                  (canon[31] & 0x7F) == (s[31] & 0x7F);
+      fe25 yy, one, v7;
+      fe_1(one);
+      fe_sq(yy, y[k]);
+      fe_sub(u[k], yy, one);
+      fe_carry(u[k]);
+      fe_mul(v[k], yy, ED_D);
+      fe_add(v[k], v[k], one);
+      fe_sq(v3[k], v[k]);
+      fe_mul(v3[k], v3[k], v[k]);
+      fe_sq(v7, v3[k]);
+      fe_mul(v7, v7, v[k]);
+      fe_mul(pin[k], u[k], v7);
+    }
+    fe_pow22523_multi(p1, pin, cnt);
+    for (int k = 0; k < cnt; k++) {
+      ok[base + k] = 0;
+      if (!pre_ok[k]) continue;
+      const uint8_t* s = encs[base + k];
+      fe25 x, xx, neg_u;
+      fe_mul(x, u[k], v3[k]);
+      fe_mul(x, x, p1[k]);
+      fe_sq(xx, x);
+      fe_mul(xx, xx, v[k]);
+      fe_neg(neg_u, u[k]);
+      if (fe_eq(xx, u[k])) {
+        // x is the root
+      } else if (fe_eq(xx, neg_u)) {
+        fe_mul(x, x, ED_SQRTM1);
+      } else {
+        continue;
+      }
+      int sign = (s[31] >> 7) & 1;
+      if (fe_iszero(x)) {
+        if (sign) continue;
+      } else if (fe_isnegative(x) != sign) {
+        fe_neg(x, x);
+      }
+      GeP3& r = out[base + k];
+      fe_copy(r.X, x);
+      fe_copy(r.Y, y[k]);
+      fe_1(r.Z);
+      fe_mul(r.T, x, y[k]);
+      ok[base + k] = 1;
+    }
+  }
+}
+
+// ───────────── scalar field mod L (Montgomery 4x64) ────────────────
+// L = 2^252 + 27742317777372353535851937790883648493. L is not of the
+// 2^256 - c shape the generic Modulus machinery folds, so scalars use
+// CIOS Montgomery multiplication instead.
+
+struct Sc25 {
+  uint64_t v[4];
+};
+
+static const uint64_t SC_L[4] = {0x5812631A5CF5D3EDULL, 0x14DEF9DEA2F79CD6ULL,
+                                 0x0000000000000000ULL, 0x1000000000000000ULL};
+static const uint64_t SC_LFACTOR = 0xD2B51DA312547E1BULL;  // -L⁻¹ mod 2^64
+static const Sc25 SC_R2 = {{0xA40611E3449C0F01ULL, 0xD00E1BA768859347ULL,
+                            0xCEEC73D217F5BE65ULL, 0x0399411B7C309A3DULL}};
+static const Sc25 SC_ONE = {{1, 0, 0, 0}};
+
+static bool sc_gte_l(const Sc25& a) {
+  for (int i = 3; i >= 0; i--) {
+    if (a.v[i] > SC_L[i]) return true;
+    if (a.v[i] < SC_L[i]) return false;
+  }
+  return true;
+}
+
+static void sc_sub_l(Sc25& a) {
+  unsigned __int128 borrow = 0;
+  for (int i = 0; i < 4; i++) {
+    unsigned __int128 d = (unsigned __int128)a.v[i] - SC_L[i] - borrow;
+    a.v[i] = (uint64_t)d;
+    borrow = (d >> 64) & 1;
+  }
+}
+
+static Sc25 sc_add(const Sc25& a, const Sc25& b) {
+  Sc25 r;
+  unsigned __int128 carry = 0;
+  for (int i = 0; i < 4; i++) {
+    carry += (unsigned __int128)a.v[i] + b.v[i];
+    r.v[i] = (uint64_t)carry;
+    carry >>= 64;
+  }
+  if (carry || sc_gte_l(r)) sc_sub_l(r);
+  return r;
+}
+
+// CIOS Montgomery: returns a·b·2^-256 mod L. Valid for a < 2^256, b < L.
+static Sc25 sc_montmul(const Sc25& a, const Sc25& b) {
+  uint64_t t[5] = {0, 0, 0, 0, 0};
+  uint64_t t5 = 0;
+  for (int i = 0; i < 4; i++) {
+    unsigned __int128 c = 0;
+    for (int j = 0; j < 4; j++) {
+      c += (unsigned __int128)a.v[i] * b.v[j] + t[j];
+      t[j] = (uint64_t)c;
+      c >>= 64;
+    }
+    c += t[4];
+    t[4] = (uint64_t)c;
+    t5 = (uint64_t)(c >> 64);
+    uint64_t m = t[0] * SC_LFACTOR;
+    c = (unsigned __int128)m * SC_L[0] + t[0];
+    c >>= 64;
+    for (int j = 1; j < 4; j++) {
+      c += (unsigned __int128)m * SC_L[j] + t[j];
+      t[j - 1] = (uint64_t)c;
+      c >>= 64;
+    }
+    c += t[4];
+    t[3] = (uint64_t)c;
+    t[4] = t5 + (uint64_t)(c >> 64);
+  }
+  Sc25 r = {{t[0], t[1], t[2], t[3]}};
+  if (t[4] || sc_gte_l(r)) sc_sub_l(r);
+  return r;
+}
+
+// a·b mod L for a, b < 2^256 (b < L).
+static Sc25 sc_mulmod(const Sc25& a, const Sc25& b) {
+  return sc_montmul(sc_montmul(a, SC_R2), b);
+}
+
+static Sc25 sc_frombytes32(const uint8_t s[32]) {
+  Sc25 r;
+  for (int i = 0; i < 4; i++) r.v[i] = load64_le(s + 8 * i);
+  return r;
+}
+
+// Reduce a 64-byte little-endian value (SHA-512 output) mod L.
+static Sc25 sc_frombytes64(const uint8_t s[64]) {
+  Sc25 lo = sc_frombytes32(s);
+  Sc25 hi = sc_frombytes32(s + 32);
+  // hi·2^256 mod L = montmul(hi, R2); lo mod L = redc(montmul(lo, R2)).
+  Sc25 hi_part = sc_montmul(hi, SC_R2);
+  Sc25 lo_part = sc_montmul(sc_montmul(lo, SC_R2), SC_ONE);
+  return sc_add(hi_part, lo_part);
+}
+
+static void sc_tobytes(uint8_t s[32], const Sc25& a) {
+  for (int i = 0; i < 4; i++) store64_le(s + 8 * i, a.v[i]);
+}
+
+static bool sc_iszero(const Sc25& a) {
+  return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+// ───────────────────────── Ed25519 engine ──────────────────────────
+
+// Fixed-base 8-bit window table for B (mirror of the secp g_table):
+// ed_b_table[w][d-1] = (256^w · d) · B in affine Niels form, so both
+// signing and the batch-verify s·B term cost ~32 mixed additions.
+static constexpr int EDT_WINDOWS = 32;
+static constexpr int EDT_ENTRIES = 255;
+static GeNiels ed_b_table[EDT_WINDOWS][EDT_ENTRIES];
+static std::once_flag ed_table_once;
+
+static void build_ed_table_impl() {
+  std::vector<GeP3> jac((size_t)EDT_WINDOWS * EDT_ENTRIES);
+  GeP3 base;
+  fe_copy(base.X, ED_BX);
+  fe_copy(base.Y, ED_BY);
+  fe_1(base.Z);
+  fe_mul(base.T, ED_BX, ED_BY);
+  for (int w = 0; w < EDT_WINDOWS; w++) {
+    GeP3 acc;
+    ge_identity(acc);
+    for (int d = 0; d < EDT_ENTRIES; d++) {
+      ge_add(acc, acc, base);
+      jac[(size_t)w * EDT_ENTRIES + d] = acc;
+    }
+    for (int b = 0; b < 8; b++) ge_dbl(base, base);
+  }
+  std::vector<uint64_t> zs((size_t)EDT_WINDOWS * EDT_ENTRIES * 5);
+  for (size_t i = 0; i < jac.size(); i++)
+    memcpy(&zs[i * 5], jac[i].Z, sizeof(fe25));
+  fe_batch_invert((fe25*)zs.data(), (int)jac.size());
+  for (size_t i = 0; i < jac.size(); i++) {
+    fe25 zi, x, y, xy;
+    memcpy(zi, &zs[i * 5], sizeof(fe25));
+    fe_mul(x, jac[i].X, zi);
+    fe_mul(y, jac[i].Y, zi);
+    GeNiels& n = ed_b_table[i / EDT_ENTRIES][i % EDT_ENTRIES];
+    fe_add(n.ypx, y, x);
+    fe_sub(n.ymx, y, x);
+    fe_mul(xy, x, y);
+    fe_mul(n.xy2d, xy, ED_2D);
+  }
+}
+
+static void build_ed_table() { std::call_once(ed_table_once, build_ed_table_impl); }
+
+// scalar · B via the fixed-base window table (scalar as 32 LE bytes).
+static void ge_scalarmult_base(GeP3& r, const uint8_t scalar[32]) {
+  build_ed_table();
+  ge_identity(r);
+  for (int w = 0; w < EDT_WINDOWS; w++) {
+    int digit = scalar[w];
+    if (digit) ge_madd(r, r, ed_b_table[w][digit - 1]);
+  }
+}
+
+// Variable-base scalar multiply via wNAF-5 (reuses the shared
+// build_wnaf5 digit scan; the table holds 1P, 3P, ..., 15P).
+struct GeWnafTable {
+  GeP3 pts[8];
+};
+
+static void ge_wnaf_table(GeWnafTable& t, const GeP3& p) {
+  t.pts[0] = p;
+  GeP3 p2;
+  ge_dbl(p2, p);
+  for (int i = 1; i < 8; i++) ge_add(t.pts[i], t.pts[i - 1], p2);
+}
+
+// Batched table build: each table is an 8-deep addition chain, so
+// interleaving a group of independent points overlaps their latencies
+// (same trick as fe_pow22523_multi).
+static void ge_wnaf_table_multi(GeWnafTable* tbls, const GeP3* pts,
+                                int count) {
+  constexpr int G = 4;
+  for (int base = 0; base < count; base += G) {
+    int cnt = std::min(G, count - base);
+    GeP3 p2[G];
+    for (int k = 0; k < cnt; k++) tbls[base + k].pts[0] = pts[base + k];
+    for (int k = 0; k < cnt; k++) ge_dbl(p2[k], pts[base + k]);
+    for (int i = 1; i < 8; i++)
+      for (int k = 0; k < cnt; k++)
+        ge_add(tbls[base + k].pts[i], tbls[base + k].pts[i - 1], p2[k]);
+  }
+}
+
+static void ge_wnaf_add_digit(GeP3& acc, const GeWnafTable& t, int d) {
+  if (d > 0) {
+    ge_add(acc, acc, t.pts[(d - 1) >> 1]);
+  } else if (d < 0) {
+    GeP3 n;
+    ge_neg(n, t.pts[((-d) - 1) >> 1]);
+    ge_add(acc, acc, n);
+  }
+}
+
+static void ge_scalarmult(GeP3& r, const GeP3& p, const Sc25& k) {
+  U256 u = {{k.v[0], k.v[1], k.v[2], k.v[3]}};
+  int8_t naf[260];
+  int len = build_wnaf5(u, naf);
+  GeWnafTable t;
+  ge_wnaf_table(t, p);
+  ge_identity(r);
+  for (int i = len - 1; i >= 0; i--) {
+    ge_dbl(r, r);
+    ge_wnaf_add_digit(r, t, naf[i]);
+  }
+}
+
+// Derive (a_scalar, prefix, A_bytes) from a 32-byte seed (RFC 8032 §5.1.5).
+static void ed_expand_key(const uint8_t seed[32], uint8_t a_clamped[32],
+                          uint8_t prefix[32], uint8_t pub[32]) {
+  Sha512 h;
+  h.update(seed, 32);
+  uint8_t digest[64];
+  h.final(digest);
+  digest[0] &= 248;
+  digest[31] &= 127;
+  digest[31] |= 64;
+  memcpy(a_clamped, digest, 32);
+  memcpy(prefix, digest + 32, 32);
+  GeP3 A;
+  ge_scalarmult_base(A, a_clamped);
+  ge_tobytes(pub, A);
+}
+
+static void ed_sign(const uint8_t seed[32], const uint8_t* msg, size_t len,
+                    uint8_t sig[64]) {
+  uint8_t a_clamped[32], prefix[32], pub[32];
+  ed_expand_key(seed, a_clamped, prefix, pub);
+  Sha512 hr;
+  hr.update(prefix, 32);
+  hr.update(msg, len);
+  uint8_t rdigest[64];
+  hr.final(rdigest);
+  Sc25 r = sc_frombytes64(rdigest);
+  uint8_t rbytes[32];
+  sc_tobytes(rbytes, r);
+  GeP3 R;
+  ge_scalarmult_base(R, rbytes);
+  ge_tobytes(sig, R);
+  Sha512 hk;
+  hk.update(sig, 32);
+  hk.update(pub, 32);
+  hk.update(msg, len);
+  uint8_t kdigest[64];
+  hk.final(kdigest);
+  Sc25 k = sc_frombytes64(kdigest);
+  // a mod L (the clamped scalar is < 2^255 but can exceed L).
+  uint8_t awide[64] = {0};
+  memcpy(awide, a_clamped, 32);
+  Sc25 a = sc_frombytes64(awide);
+  Sc25 s = sc_add(sc_mulmod(k, a), r);
+  sc_tobytes(sig + 32, s);
+}
+
+// Cofactored verification: accept iff 8·(s·B - h·A - R) is the identity.
+// Batch verification is only sound for the cofactored equation (the
+// random linear combination multiplies the whole sum by 8), so the
+// scalar path uses the same criterion — scalar and batch verdicts can
+// then never disagree on any input (PARITY.md documents the contrast
+// with cofactorless verifiers).
+static bool ed_verify_decoded(const GeP3& A, const GeP3& R, const Sc25& s,
+                              const Sc25& h) {
+  uint8_t sbytes[32];
+  sc_tobytes(sbytes, s);
+  GeP3 sB, hA, q, t;
+  ge_scalarmult_base(sB, sbytes);
+  ge_scalarmult(hA, A, h);
+  ge_neg(t, hA);
+  ge_add(q, sB, t);
+  ge_neg(t, R);
+  ge_add(q, q, t);
+  ge_dbl(q, q);
+  ge_dbl(q, q);
+  ge_dbl(q, q);
+  return ge_is_identity(q);
+}
+
+static int ed_verify_one(const uint8_t pub[32], const uint8_t* msg, size_t len,
+                         const uint8_t sig[64]) {
+  build_ed_table();
+  Sc25 s = sc_frombytes32(sig + 32);
+  if (sc_gte_l(s)) return 0;  // non-canonical s: malleable, rejected
+  GeP3 A, R;
+  if (!ge_frombytes(A, pub)) return 0;
+  if (!ge_frombytes(R, sig)) return 0;
+  Sha512 hh;
+  hh.update(sig, 32);
+  hh.update(pub, 32);
+  hh.update(msg, len);
+  uint8_t hdigest[64];
+  hh.final(hdigest);
+  Sc25 h = sc_frombytes64(hdigest);
+  return ed_verify_decoded(A, R, s, h) ? 1 : 0;
+}
+
+// 128-bit batch randomizers from a per-thread splitmix64 stream seeded
+// by the OS entropy source. Fresh per batch: an attacker cannot grind a
+// randomizer they never observe, and 2^-128 bounds the chance a forged
+// batch survives the linear combination.
+static thread_local uint64_t ed_rng_state = 0;
+
+static uint64_t ed_rand64() {
+  if (ed_rng_state == 0) {
+    std::random_device rd;
+    ed_rng_state = ((uint64_t)rd() << 32) ^ rd() ^ 0x9E3779B97F4A7C15ULL;
+  }
+  uint64_t z = (ed_rng_state += 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+// Randomized-linear-combination batch verification over one chunk:
+// checks 8·Σ zᵢ(sᵢB - hᵢAᵢ - Rᵢ) == O via one Straus multi-scalar
+// multiply — per signature, ~21 ladder additions for the 128-bit zᵢ on
+// Rᵢ instead of a full double-scalar multiply. Identities repeat
+// heavily in consensus traffic, so Aᵢ terms are grouped per unique
+// pubkey: one decompression, one wNAF table, and one 253-bit scalar
+// (Σ zᵢhᵢ mod L) per SIGNER rather than per signature. On failure the
+// chunk falls back to per-item scalar verification for exact verdicts
+// (the RLC cannot false-reject: an all-valid chunk always sums to the
+// identity after the cofactor multiply).
+static void ed_verify_batch_range(const uint8_t* pubs, const uint8_t* msgs,
+                                  const uint64_t* offsets, const uint8_t* sigs,
+                                  int64_t lo, int64_t hi, uint8_t* results) {
+  build_ed_table();
+  const int64_t n = hi - lo;
+  if (n <= 0) return;
+  if (n == 1) {
+    results[lo] = (uint8_t)ed_verify_one(
+        pubs + 32 * lo, msgs + offsets[lo], offsets[lo + 1] - offsets[lo],
+        sigs + 64 * lo);
+    return;
+  }
+  struct Item {
+    GeP3 R;
+    Sc25 s, h, z;
+    int a_slot;
+    bool s_ok, ok;
+  };
+  std::vector<Item> items(n);
+  // Unique pubkeys in this chunk -> decoded point + accumulated scalar.
+  struct ASlot {
+    GeP3 A;
+    Sc25 coeff;
+    bool decoded, used;
+  };
+  std::vector<ASlot> aslots;
+  std::vector<const uint8_t*> akeys;
+  // Pass 1: scalar-range check, identity grouping (linear scan is fine
+  // at chunk scale — the signer population per chunk is small by
+  // construction), per-item hash and randomizer. Point decompression is
+  // deferred so it can run BATCHED below.
+  for (int64_t j = 0; j < n; j++) {
+    int64_t i = lo + j;
+    Item& it = items[j];
+    it.ok = false;
+    const uint8_t* pub = pubs + 32 * i;
+    const uint8_t* sig = sigs + 64 * i;
+    it.s = sc_frombytes32(sig + 32);
+    it.s_ok = !sc_gte_l(it.s);
+    if (!it.s_ok) {
+      results[i] = 0;
+      continue;
+    }
+    int slot = -1;
+    for (size_t k = 0; k < akeys.size(); k++)
+      if (memcmp(akeys[k], pub, 32) == 0) {
+        slot = (int)k;
+        break;
+      }
+    if (slot < 0) {
+      ASlot as;
+      as.decoded = false;
+      as.used = false;
+      as.coeff = Sc25{{0, 0, 0, 0}};
+      slot = (int)aslots.size();
+      aslots.push_back(as);
+      akeys.push_back(pub);
+    }
+    it.a_slot = slot;
+    Sha512 hh;
+    hh.update(sig, 32);
+    hh.update(pub, 32);
+    hh.update(msgs + offsets[i], offsets[i + 1] - offsets[i]);
+    uint8_t hdigest[64];
+    hh.final(hdigest);
+    it.h = sc_frombytes64(hdigest);
+    it.z = Sc25{{ed_rand64(), ed_rand64(), 0, 0}};
+  }
+  // Batched decompression: all unique A's, then all R's.
+  {
+    std::vector<GeP3> apts(aslots.size());
+    std::vector<uint8_t> aok(aslots.size());
+    if (!aslots.empty()) {
+      ge_frombytes_multi(apts.data(), aok.data(), akeys.data(),
+                         (int)aslots.size());
+      for (size_t k = 0; k < aslots.size(); k++) {
+        aslots[k].A = apts[k];
+        aslots[k].decoded = aok[k] != 0;
+      }
+    }
+    std::vector<const uint8_t*> rencs;
+    std::vector<int64_t> rrows;
+    rencs.reserve(n);
+    rrows.reserve(n);
+    for (int64_t j = 0; j < n; j++)
+      if (items[j].s_ok && aslots[items[j].a_slot].decoded) {
+        rencs.push_back(sigs + 64 * (lo + j));
+        rrows.push_back(j);
+      }
+    std::vector<GeP3> rpts(rencs.size());
+    std::vector<uint8_t> rok(rencs.size());
+    if (!rencs.empty())
+      ge_frombytes_multi(rpts.data(), rok.data(), rencs.data(),
+                         (int)rencs.size());
+    for (size_t k = 0; k < rrows.size(); k++)
+      if (rok[k]) {
+        items[rrows[k]].R = rpts[k];
+        items[rrows[k]].ok = true;
+      }
+  }
+  // Pass 2: accumulate the linear combination over decodable items.
+  Sc25 s_total = {{0, 0, 0, 0}};
+  for (int64_t j = 0; j < n; j++) {
+    int64_t i = lo + j;
+    Item& it = items[j];
+    if (!it.ok) {
+      results[i] = 0;
+      continue;
+    }
+    ASlot& as = aslots[it.a_slot];
+    as.coeff = sc_add(as.coeff, sc_mulmod(it.z, it.h));
+    as.used = true;
+    s_total = sc_add(s_total, sc_mulmod(it.z, it.s));
+    results[i] = 1;  // provisional; confirmed by the combination below
+  }
+  // Straus MSM: acc = Σ zᵢ·(-Rᵢ) + Σ coeffⱼ·(-Aⱼ), then + s_total·B.
+  struct Strand {
+    int8_t naf[260];
+    int len;
+    int lane;  // which accumulator this strand lands on
+  };
+  // Four independent accumulator lanes: the joint ladder is one long
+  // dependency chain per accumulator (each dbl/add waits on the last),
+  // so splitting strands across lanes lets the CPU overlap the field
+  // multiplies of four chains (~1.4x on the MSM). The short 128-bit zᵢ
+  // strands share lanes 0-2 — their lanes only start doubling halfway
+  // up the window range — and the full-width per-signer coefficient
+  // strands take lane 3.
+  std::vector<Strand> strands;
+  std::vector<GeP3> neg_pts;
+  strands.reserve(items.size() + aslots.size());
+  neg_pts.reserve(items.size() + aslots.size());
+  int max_len = 0;
+  int r_count = 0;
+  for (int64_t j = 0; j < n; j++) {
+    if (!items[j].ok) continue;
+    Strand st;
+    U256 u = {{items[j].z.v[0], items[j].z.v[1], 0, 0}};
+    st.len = build_wnaf5(u, st.naf);
+    st.lane = r_count++ % 3;
+    if (st.len > max_len) max_len = st.len;
+    strands.push_back(st);
+    GeP3 neg;
+    ge_neg(neg, items[j].R);
+    neg_pts.push_back(neg);
+  }
+  for (auto& as : aslots) {
+    if (!as.used || sc_iszero(as.coeff)) continue;
+    Strand st;
+    U256 u = {{as.coeff.v[0], as.coeff.v[1], as.coeff.v[2], as.coeff.v[3]}};
+    st.len = build_wnaf5(u, st.naf);
+    st.lane = 3;
+    if (st.len > max_len) max_len = st.len;
+    strands.push_back(st);
+    GeP3 neg;
+    ge_neg(neg, as.A);
+    neg_pts.push_back(neg);
+  }
+  // Per-strand odd-multiple tables, built interleaved (ILP).
+  std::vector<GeWnafTable> tbls(strands.size());
+  if (!strands.empty())
+    ge_wnaf_table_multi(tbls.data(), neg_pts.data(), (int)strands.size());
+  GeP3 accs[4];
+  bool active[4] = {false, false, false, false};
+  for (auto& a : accs) ge_identity(a);
+  for (int i = max_len - 1; i >= 0; i--) {
+    for (int k = 0; k < 4; k++)
+      if (active[k]) ge_dbl(accs[k], accs[k]);
+    for (size_t si = 0; si < strands.size(); si++) {
+      const Strand& st = strands[si];
+      if (i < st.len && st.naf[i]) {
+        ge_wnaf_add_digit(accs[st.lane], tbls[si], st.naf[i]);
+        active[st.lane] = true;
+      }
+    }
+  }
+  GeP3 acc, t01, t23;
+  ge_add(t01, accs[0], accs[1]);
+  ge_add(t23, accs[2], accs[3]);
+  ge_add(acc, t01, t23);
+  uint8_t stb[32];
+  sc_tobytes(stb, s_total);
+  GeP3 sB;
+  ge_scalarmult_base(sB, stb);
+  ge_add(acc, acc, sB);
+  ge_dbl(acc, acc);
+  ge_dbl(acc, acc);
+  ge_dbl(acc, acc);
+  if (ge_is_identity(acc)) return;  // malformed items are already 0
+  // Combination failed: at least one bad signature — resolve exactly.
+  for (int64_t j = 0; j < n; j++) {
+    int64_t i = lo + j;
+    if (!items[j].ok) continue;  // already 0
+    results[i] = (uint8_t)ed_verify_one(
+        pubs + 32 * i, msgs + offsets[i], offsets[i + 1] - offsets[i],
+        sigs + 64 * i);
+  }
 }
 
 // ───────────────────────────── C ABI ───────────────────────────────
@@ -1231,14 +2614,12 @@ void hg_keccak256_batch(const uint8_t* data, const uint64_t* offsets,
   hash_batch(data, offsets, count, out, n_threads, keccak256);
 }
 
-// EIP-191 verify. identities: 20*i, payload spans offsets, sigs: 65*i.
-// results[i]: 1 valid, 0 address mismatch, 255 malformed recovery byte,
-// 254 recovery failed (the latter two map to scheme errors).
-void hg_eth_verify_batch(const uint8_t* identities, const uint8_t* payloads,
-                         const uint64_t* offsets, const uint8_t* sigs,
-                         int64_t count, uint8_t* results, int n_threads) {
-  build_g_table();
-  run_parallel(count, n_threads, 4, [&](int64_t lo, int64_t hi) {
+// Worker body shared by the sync and async EIP-191 batch entry points:
+// verify items [lo, hi) into results.
+static void eth_verify_range(const uint8_t* identities, const uint8_t* payloads,
+                             const uint64_t* offsets, const uint8_t* sigs,
+                             int64_t lo, int64_t hi, uint8_t* results) {
+  {
     // Chunked so the three Montgomery batch inversions (r⁻¹ mod n before
     // the scalar multiplies, the per-item wNAF-table z's for the affine
     // GLV ladder, and q's z for the final affine conversion) each amortise
@@ -1307,6 +2688,18 @@ void hg_eth_verify_batch(const uint8_t* identities, const uint8_t* payloads,
         results[i] = memcmp(addr, identities + 20 * i, 20) == 0 ? 1 : 0;
       }
     }
+  }
+}
+
+// EIP-191 verify. identities: 20*i, payload spans offsets, sigs: 65*i.
+// results[i]: 1 valid, 0 address mismatch, 255 malformed recovery byte,
+// 254 recovery failed (the latter two map to scheme errors).
+void hg_eth_verify_batch(const uint8_t* identities, const uint8_t* payloads,
+                         const uint64_t* offsets, const uint8_t* sigs,
+                         int64_t count, uint8_t* results, int n_threads) {
+  build_g_table();
+  run_parallel(count, n_threads, 4, [&](int64_t lo, int64_t hi) {
+    eth_verify_range(identities, payloads, offsets, sigs, lo, hi, results);
   });
 }
 
@@ -1401,6 +2794,94 @@ void hg_gids_live(const int64_t* gids, int64_t count, const uint8_t* live,
   });
 }
 
-int hg_version() { return 2; }
+// ── Persistent verify pool ─────────────────────────────────────────
+
+// (Re)size the worker pool; n <= 0 restores the hardware default.
+// Returns the resulting thread count. Safe between batches.
+int hg_pool_configure(int n_threads) {
+  return WorkerPool::instance().configure(n_threads);
+}
+
+int hg_pool_size() { return WorkerPool::instance().size(); }
+
+// Tasks queued + running — the /metrics verify-pool queue-depth gauge.
+int64_t hg_pool_queue_depth() { return WorkerPool::instance().depth(); }
+
+// Block until an async job (from a *_submit call) completes. Returns 0
+// on success, 1 for an unknown/already-collected handle. Results were
+// written into the caller's buffers by the workers; the caller must
+// keep every buffer passed to submit alive until this returns.
+int hg_pool_wait(int64_t job) {
+  return WorkerPool::instance().wait_handle(job);
+}
+
+// Async hg_eth_verify_batch: returns a job handle immediately; the
+// worker pool fills `results` in the background (GIL-free), so Python
+// can overlap device work with host ECDSA. Collect via hg_pool_wait.
+int64_t hg_eth_verify_batch_submit(const uint8_t* identities,
+                                   const uint8_t* payloads,
+                                   const uint64_t* offsets,
+                                   const uint8_t* sigs, int64_t count,
+                                   uint8_t* results) {
+  build_g_table();
+  return submit_parallel(count, 64, [=](int64_t lo, int64_t hi) {
+    eth_verify_range(identities, payloads, offsets, sigs, lo, hi, results);
+  });
+}
+
+// ── Ed25519 ────────────────────────────────────────────────────────
+
+// Public key for a 32-byte seed (RFC 8032 §5.1.5). Returns 0.
+int hg_ed25519_public(const uint8_t* seed, uint8_t* pub_out) {
+  build_ed_table();
+  uint8_t a[32], prefix[32];
+  ed_expand_key(seed, a, prefix, pub_out);
+  return 0;
+}
+
+// Sign payload with a 32-byte seed; writes R || S (64 bytes). Returns 0.
+int hg_ed25519_sign(const uint8_t* seed, const uint8_t* payload, uint64_t len,
+                    uint8_t* sig_out) {
+  build_ed_table();
+  ed_sign(seed, payload, len, sig_out);
+  return 0;
+}
+
+// Verify one signature (cofactored; see ed_verify_decoded). Returns 1
+// valid, 0 invalid (bad point encodings and non-canonical s included).
+int hg_ed25519_verify(const uint8_t* pub, const uint8_t* payload, uint64_t len,
+                      const uint8_t* sig) {
+  return ed_verify_one(pub, payload, len, sig);
+}
+
+// Batched Ed25519 verification: pubs at 32·i, payload spans offsets,
+// sigs at 64·i. results[i]: 1 valid, 0 invalid. Chunks of <= 64 run the
+// randomized-linear-combination batch equation across the worker pool.
+void hg_ed25519_verify_batch(const uint8_t* pubs, const uint8_t* payloads,
+                             const uint64_t* offsets, const uint8_t* sigs,
+                             int64_t count, uint8_t* results, int n_threads) {
+  build_ed_table();
+  run_parallel(count, n_threads, 4, [&](int64_t lo, int64_t hi) {
+    for (int64_t base = lo; base < hi; base += 256)
+      ed_verify_batch_range(pubs, payloads, offsets, sigs, base,
+                            std::min<int64_t>(hi, base + 256), results);
+  });
+}
+
+// Async hg_ed25519_verify_batch (collect via hg_pool_wait).
+int64_t hg_ed25519_verify_batch_submit(const uint8_t* pubs,
+                                       const uint8_t* payloads,
+                                       const uint64_t* offsets,
+                                       const uint8_t* sigs, int64_t count,
+                                       uint8_t* results) {
+  build_ed_table();
+  return submit_parallel(count, 256, [=](int64_t lo, int64_t hi) {
+    for (int64_t base = lo; base < hi; base += 256)
+      ed_verify_batch_range(pubs, payloads, offsets, sigs, base,
+                            std::min<int64_t>(hi, base + 256), results);
+  });
+}
+
+int hg_version() { return 3; }
 
 }  // extern "C"

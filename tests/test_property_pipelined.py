@@ -1,0 +1,79 @@
+"""Property-based fuzz: pipelined ingest vs sequential as oracle
+(hypothesis drives the script space beyond test_pipelined_ingest.py's
+hand-written cases)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings, strategies as st
+
+from hashgraph_tpu import build_vote
+
+from common import NOW
+from test_pipelined_ingest import (
+    N_SIGNERS,
+    SIGNERS,
+    _fresh_engine,
+    _req,
+    _state_fingerprint,
+)
+
+# One op per entry: (proposal index, signer index, kind) where kind
+# selects a clean vote, a corrupted signature, a duplicate, or a vote
+# for an unknown session.
+op_lists = st.lists(
+    st.tuples(
+        st.integers(min_value=0, max_value=2),
+        st.integers(min_value=0, max_value=N_SIGNERS - 1),
+        st.sampled_from(["ok", "bad_sig", "dup", "unknown"]),
+    ),
+    min_size=1,
+    max_size=24,
+)
+
+
+@settings(max_examples=25, deadline=None)
+@given(ops=op_lists, batch_size=st.integers(min_value=1, max_value=7))
+def test_property_pipelined_equals_sequential(ops, batch_size):
+    """For ANY vote script and batching, pipelined == sequential:
+    statuses, stored chains, and per-session vote maps."""
+    seq = _fresh_engine()
+    pip = _fresh_engine()
+    fingerprints = []
+    outs = []
+    for engine in (seq, pip):
+        proposals = [
+            engine.create_proposal("s", _req(), NOW) for _ in range(3)
+        ]
+        items = []
+        last = {}
+        for p_idx, s_idx, kind in ops:
+            proposal = proposals[p_idx]
+            if kind == "dup" and (p_idx, s_idx) in last:
+                items.append(("s", last[(p_idx, s_idx)].clone()))
+                continue
+            vote = build_vote(
+                proposal, bool(s_idx % 2), SIGNERS[s_idx], NOW + 1 + s_idx
+            )
+            if kind == "bad_sig":
+                vote.signature = bytes([vote.signature[0] ^ 1]) + vote.signature[1:]
+            elif kind == "unknown":
+                vote.proposal_id = 777_000 + p_idx
+            else:
+                last[(p_idx, s_idx)] = vote
+            items.append(("s", vote))
+        batches = [
+            items[k : k + batch_size] for k in range(0, len(items), batch_size)
+        ]
+        if engine is seq:
+            outs.append([engine.ingest_votes(b, NOW) for b in batches])
+        else:
+            outs.append(engine.ingest_votes_pipelined(batches, NOW))
+        fingerprints.append(
+            _state_fingerprint(engine, "s", [p.proposal_id for p in proposals])
+        )
+    for a, b in zip(outs[0], outs[1]):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert fingerprints[0] == fingerprints[1]

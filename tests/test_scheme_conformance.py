@@ -1,0 +1,209 @@
+"""Scheme conformance: every ConsensusSignatureScheme implementation must
+honor the same contract — sign→verify round-trips, scalar-vs-batch verdict
+equivalence (including the async submit/collect pair), the ragged-input
+zip-truncation rule, and malformed-length scheme errors — so the engine's
+batched/pipelined ingest paths can treat schemes interchangeably
+(reference: src/signing.rs:46-74)."""
+
+import pytest
+
+from hashgraph_tpu.errors import ConsensusSchemeError
+from hashgraph_tpu.signing import (
+    Ed25519ConsensusSigner,
+    EthereumConsensusSigner,
+    PendingVerdicts,
+    StubConsensusSigner,
+)
+from hashgraph_tpu.signing import _ed25519 as ed_py
+from hashgraph_tpu import native
+
+
+def _make_stub():
+    return StubConsensusSigner(b"\x07" * 20)
+
+
+SCHEMES = [
+    pytest.param(_make_stub, id="stub"),
+    pytest.param(EthereumConsensusSigner.random, id="ethereum"),
+    pytest.param(Ed25519ConsensusSigner.random, id="ed25519"),
+]
+
+
+def _batch(make_signer, n=6):
+    """n signed items + a forged one + a cross-signed one."""
+    signers = [make_signer() for _ in range(3)]
+    idents, payloads, sigs = [], [], []
+    for i in range(n):
+        s = signers[i % 3]
+        payload = b"payload-%d" % i
+        idents.append(s.identity())
+        payloads.append(payload)
+        sigs.append(s.sign(payload))
+    return idents, payloads, sigs
+
+
+class TestSchemeConformance:
+    @pytest.mark.parametrize("make_signer", SCHEMES)
+    def test_sign_verify_roundtrip(self, make_signer):
+        signer = make_signer()
+        cls = type(signer)
+        sig = signer.sign(b"hello")
+        assert cls.verify(signer.identity(), b"hello", sig) is True
+        assert cls.verify(signer.identity(), b"hellO", sig) is False
+
+    @pytest.mark.parametrize("make_signer", SCHEMES)
+    def test_wrong_identity_fails(self, make_signer):
+        a, b = make_signer(), make_signer()
+        if a.identity() == b.identity():  # stub factory is deterministic
+            b = StubConsensusSigner(b"\x08" * 20)
+        sig = a.sign(b"payload")
+        assert type(a).verify(b.identity(), b"payload", sig) is False
+
+    @pytest.mark.parametrize("make_signer", SCHEMES)
+    def test_scalar_vs_batch_equivalence(self, make_signer):
+        """verify_batch yields exactly what per-item verify would —
+        verdict for verdict, scheme error for scheme error."""
+        idents, payloads, sigs = _batch(make_signer)
+        cls = type(make_signer())
+        # Corrupt one signature, cross-wire another, malform a third.
+        sigs[1] = bytes([sigs[1][0] ^ 1]) + sigs[1][1:]
+        idents[2], idents[3] = idents[3], idents[2]
+        sigs[4] = b"short"
+        batch = cls.verify_batch(idents, payloads, sigs)
+        assert len(batch) == len(idents)
+        for ident, payload, sig, got in zip(idents, payloads, sigs, batch):
+            try:
+                want = cls.verify(ident, payload, sig)
+            except ConsensusSchemeError as exc:
+                want = exc
+            if isinstance(want, ConsensusSchemeError):
+                assert isinstance(got, ConsensusSchemeError)
+            else:
+                assert got is want, (got, want)
+
+    @pytest.mark.parametrize("make_signer", SCHEMES)
+    def test_submit_collect_matches_batch(self, make_signer):
+        idents, payloads, sigs = _batch(make_signer)
+        cls = type(make_signer())
+        sigs[0] = bytes([sigs[0][0] ^ 1]) + sigs[0][1:]
+        pend = cls.verify_batch_submit(idents, payloads, sigs)
+        assert isinstance(pend, PendingVerdicts)
+        got = pend.collect()
+        want = cls.verify_batch(idents, payloads, sigs)
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            if isinstance(w, ConsensusSchemeError):
+                assert isinstance(g, ConsensusSchemeError)
+            else:
+                assert g is w
+        # collect() is idempotent.
+        assert pend.collect() is got
+
+    @pytest.mark.parametrize("make_signer", SCHEMES)
+    def test_ragged_inputs_zip_truncate(self, make_signer):
+        """The base-class contract: ragged inputs truncate to the
+        shortest list, never raise, never index past it."""
+        idents, payloads, sigs = _batch(make_signer, n=4)
+        cls = type(make_signer())
+        out = cls.verify_batch(idents, payloads[:2], sigs)
+        assert len(out) == 2
+        assert all(v is True for v in out)
+        pend = cls.verify_batch_submit(idents[:3], payloads, sigs)
+        assert len(pend.collect()) == 3
+
+    @pytest.mark.parametrize("make_signer", SCHEMES)
+    def test_empty_batch(self, make_signer):
+        cls = type(make_signer())
+        assert cls.verify_batch([], [], []) == []
+        assert cls.verify_batch_submit([], [], []).collect() == []
+
+
+class TestLengthErrors:
+    """Wrong-length identities/signatures are scheme ERRORS (distinct
+    from a False verdict) for the fixed-length schemes."""
+
+    @pytest.mark.parametrize(
+        "make_signer", [SCHEMES[1], SCHEMES[2]]
+    )
+    def test_malformed_lengths_are_scheme_errors(self, make_signer):
+        signer = make_signer()
+        cls = type(signer)
+        sig = signer.sign(b"p")
+        with pytest.raises(ConsensusSchemeError):
+            cls.verify(signer.identity(), b"p", b"\x01\x02")
+        with pytest.raises(ConsensusSchemeError):
+            cls.verify(b"\x01" * 5, b"p", sig)
+        out = cls.verify_batch(
+            [signer.identity(), b"\x01" * 5, signer.identity()],
+            [b"p", b"p", b"p"],
+            [sig, sig, b"xx"],
+        )
+        assert out[0] is True
+        assert isinstance(out[1], ConsensusSchemeError)
+        assert isinstance(out[2], ConsensusSchemeError)
+
+
+class TestEd25519Specifics:
+    def test_rfc8032_vectors(self):
+        seed = bytes.fromhex(
+            "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+        )
+        signer = Ed25519ConsensusSigner(seed)
+        assert signer.identity().hex() == (
+            "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+        )
+        sig = signer.sign(b"")
+        assert sig.hex() == (
+            "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e0652249"
+            "01555fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe2465514143"
+            "8e7a100b"
+        )
+        assert Ed25519ConsensusSigner.verify(signer.identity(), b"", sig)
+
+    def test_native_and_fallback_agree(self):
+        """The pure-Python RFC 8032 fallback and the native core must be
+        byte-identical on keys and signatures and agree on verdicts."""
+        signer = Ed25519ConsensusSigner.random()
+        seed = signer.private_key_bytes()
+        msg = b"cross-check"
+        sig = signer.sign(msg)
+        assert ed_py.public_key(seed) == signer.identity()
+        assert ed_py.sign(seed, msg) == sig
+        assert ed_py.verify(signer.identity(), msg, sig)
+        assert not ed_py.verify(signer.identity(), msg + b"!", sig)
+
+    def test_non_canonical_scalar_rejected(self):
+        """s >= L is the malleable form; RFC 8032 verifiers reject it."""
+        signer = Ed25519ConsensusSigner.random()
+        sig = signer.sign(b"m")
+        s = int.from_bytes(sig[32:], "little")
+        bumped = sig[:32] + (s + ed_py.L).to_bytes(32, "little")
+        assert Ed25519ConsensusSigner.verify(signer.identity(), b"m", bumped) is False
+        assert ed_py.verify(signer.identity(), b"m", bumped) is False
+
+    def test_undecodable_points_are_false_not_errors(self):
+        signer = Ed25519ConsensusSigner.random()
+        sig = signer.sign(b"m")
+        # A pubkey encoding with y >= p is non-canonical -> False.
+        assert (
+            Ed25519ConsensusSigner.verify(b"\xff" * 32, b"m", sig) is False
+        )
+        out = Ed25519ConsensusSigner.verify_batch(
+            [b"\xff" * 32], [b"m"], [sig]
+        )
+        assert out == [False]
+
+    @pytest.mark.skipif(not native.available(), reason="native runtime absent")
+    def test_native_batch_mixed_verdicts_exact(self):
+        """The randomized-linear-combination fast path must fall back to
+        exact per-item verdicts when the combination fails."""
+        signers = [Ed25519ConsensusSigner.random() for _ in range(4)]
+        payloads = [b"m%d" % i for i in range(64)]
+        idents = [signers[i % 4].identity() for i in range(64)]
+        sigs = [signers[i % 4].sign(p) for i, p in enumerate(payloads)]
+        bad = {3, 17, 40, 63}
+        for i in bad:
+            sigs[i] = bytes([sigs[i][0] ^ 1]) + sigs[i][1:]
+        out = Ed25519ConsensusSigner.verify_batch(idents, payloads, sigs)
+        for i, verdict in enumerate(out):
+            assert verdict is (i not in bad)

@@ -315,6 +315,44 @@ class TestEngineIntegration:
         [code] = rejecting.ingest_votes([("s", vote.clone())], NOW + 2)
         assert int(code) == int(StatusCode.INVALID_VOTE_SIGNATURE)
 
+    def test_ed25519_verdict_never_cross_served_to_ethereum(self):
+        """The production-scheme pair specifically: an Ed25519 engine's
+        cached True for some (payload, signature) bytes must never
+        satisfy an Ethereum engine's verification of the SAME bytes —
+        the admission key is namespaced by an 8-byte scheme tag derived
+        from the scheme type, so the two schemes occupy disjoint key
+        spaces in one shared cache."""
+        from hashgraph_tpu.signing import (
+            Ed25519ConsensusSigner,
+            EthereumConsensusSigner,
+        )
+
+        shared = VerifiedVoteCache()
+        ed = TpuConsensusEngine(
+            Ed25519ConsensusSigner.random(),
+            capacity=8,
+            voter_capacity=4,
+            verify_cache=shared,
+        )
+        eth = TpuConsensusEngine(
+            EthereumConsensusSigner.random(),
+            capacity=8,
+            voter_capacity=4,
+            verify_cache=shared,
+        )
+        assert ed._verify_scheme_tag != eth._verify_scheme_tag
+        # The same (payload, signature) bytes key differently per scheme,
+        # so a verdict stored under the Ed25519 tag is a MISS under the
+        # Ethereum tag.
+        payload, sig = b"same-bytes", b"\x01" * 64
+        ed_key = VerifiedVoteCache.key(payload, sig, ed._verify_scheme_tag)
+        eth_key = VerifiedVoteCache.key(payload, sig, eth._verify_scheme_tag)
+        assert ed_key != eth_key
+        from hashgraph_tpu.engine.verify_cache import MISS
+
+        shared.put(ed_key, True)
+        assert shared.get(eth_key) is MISS
+
     def test_expired_proposal_batch_buys_no_crypto(self):
         """Redelivered EXPIRED chains are excluded from the batch verify
         prepass — the same zero-crypto fail-fast the scalar path has."""

@@ -1,0 +1,131 @@
+"""Persistent native verify pool: configuration, async submit/collect,
+queue-depth telemetry, and equivalence of the async results with the
+synchronous batch entry points. Skipped entirely when the native runtime
+is unavailable (every caller has a pure-Python fallback)."""
+
+import threading
+
+import pytest
+
+from hashgraph_tpu import native
+from hashgraph_tpu.signing import Ed25519ConsensusSigner, EthereumConsensusSigner
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native runtime unavailable"
+)
+
+
+@pytest.fixture(autouse=True)
+def _restore_pool():
+    yield
+    native.pool_configure(0)  # hardware default back for other tests
+
+
+class TestPoolConfig:
+    def test_configure_and_size(self):
+        assert native.pool_configure(2) == 2
+        assert native.pool_size() == 2
+        assert native.pool_configure(1) == 1
+        assert native.pool_size() == 1
+        # <= 0 restores the hardware default (>= 1).
+        assert native.pool_configure(0) >= 1
+
+    def test_queue_depth_idle(self):
+        assert native.pool_queue_depth() == 0
+        # The metrics-safe readout never triggers a load; the runtime is
+        # already loaded here, so it reports the same number.
+        assert native.pool_queue_depth_if_loaded() == 0
+
+    def test_wait_unknown_handle_is_error_not_hang(self):
+        lib = native._load()
+        assert lib.hg_pool_wait(999_999_999) == 1
+
+
+class TestAsyncSubmit:
+    def test_eth_submit_matches_sync(self):
+        signers = [EthereumConsensusSigner.random() for _ in range(3)]
+        payloads = [b"p%d" % i for i in range(24)]
+        idents = [signers[i % 3].identity() for i in range(24)]
+        sigs = [signers[i % 3].sign(p) for i, p in enumerate(payloads)]
+        sigs[5] = bytes([sigs[5][0] ^ 1]) + sigs[5][1:]
+        sigs[6] = sigs[6][:64] + b"\x09"  # malformed recovery byte
+        job = native.eth_verify_batch_submit(idents, payloads, sigs)
+        assert job is not None
+        sync = native.eth_verify_batch(idents, payloads, sigs)
+        assert list(job.collect()) == list(sync)
+        # collect() is idempotent.
+        assert list(job.collect()) == list(sync)
+
+    def test_ed25519_submit_matches_sync(self):
+        signers = [Ed25519ConsensusSigner.random() for _ in range(3)]
+        payloads = [b"p%d" % i for i in range(24)]
+        idents = [signers[i % 3].identity() for i in range(24)]
+        sigs = [signers[i % 3].sign(p) for i, p in enumerate(payloads)]
+        sigs[7] = bytes([sigs[7][0] ^ 1]) + sigs[7][1:]
+        job = native.ed25519_verify_batch_submit(idents, payloads, sigs)
+        assert job is not None
+        sync = native.ed25519_verify_batch(idents, payloads, sigs)
+        assert list(job.collect()) == list(sync)
+
+    def test_many_overlapping_jobs(self):
+        """Several in-flight jobs complete independently and correctly
+        regardless of collect order."""
+        signer = Ed25519ConsensusSigner.random()
+        jobs = []
+        for j in range(6):
+            payloads = [b"j%d-%d" % (j, i) for i in range(32)]
+            sigs = [signer.sign(p) for p in payloads]
+            jobs.append(
+                native.ed25519_verify_batch_submit(
+                    [signer.identity()] * 32, payloads, sigs
+                )
+            )
+        for job in reversed(jobs):
+            assert list(job.collect()) == [1] * 32
+
+    def test_submit_from_threads(self):
+        signer = Ed25519ConsensusSigner.random()
+        payloads = [b"t%d" % i for i in range(16)]
+        sigs = [signer.sign(p) for p in payloads]
+        errors = []
+
+        def worker():
+            try:
+                job = native.ed25519_verify_batch_submit(
+                    [signer.identity()] * 16, payloads, sigs
+                )
+                assert list(job.collect()) == [1] * 16
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+    def test_single_thread_pool_still_completes(self):
+        native.pool_configure(1)
+        signer = Ed25519ConsensusSigner.random()
+        payloads = [b"s%d" % i for i in range(8)]
+        sigs = [signer.sign(p) for p in payloads]
+        job = native.ed25519_verify_batch_submit(
+            [signer.identity()] * 8, payloads, sigs
+        )
+        assert list(job.collect()) == [1] * 8
+
+
+class TestSchemeSubmitFallback:
+    def test_stub_default_defers_to_collect(self):
+        """Schemes without a native path get the deferred-sync default —
+        identical verdicts, no pool involvement."""
+        from hashgraph_tpu.signing import StubConsensusSigner
+
+        s = StubConsensusSigner(b"\x01" * 20)
+        payloads = [b"a", b"b"]
+        sigs = [s.sign(p) for p in payloads]
+        pend = StubConsensusSigner.verify_batch_submit(
+            [s.identity()] * 2, payloads, sigs
+        )
+        assert pend.collect() == [True, True]
