@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench bench-redelivery metrics-smoke trace-smoke smoke
+.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet fleet-smoke metrics-smoke trace-smoke smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -32,6 +32,17 @@ bench:
 # the same paths is tests/test_redelivery.py (stub signer).
 bench-redelivery:
 	python bench.py redelivery
+
+# Scope-sharded fleet bench: aggregate votes/sec across all local
+# devices, per-shard breakdown, paired fleet-vs-single-shard A/B with a
+# machine-readable noise_verdict, and a MULTICHIP-compatible record.
+bench-fleet:
+	python bench.py fleet
+
+# CI short run: 2 simulated shards on virtual CPU devices — exercises
+# fleet routing, the psum tally path, and the sweep on every PR.
+fleet-smoke:
+	JAX_PLATFORMS=cpu python bench.py fleet --smoke
 
 # End-to-end observability check: start a bridge server (WAL + HTTP
 # sidecar), drive a proposal to decision, scrape /metrics + /healthz and

@@ -18,16 +18,26 @@ BASELINE shape:
 
 Individual runs via argv: engine | pool (alias config3) | config2 |
 config4 | config5 | lanes1024 | crypto | validated | redelivery | wal |
-default | all (``all`` prints newline-separated JSON, one line per
-section). ``wal`` measures the durability subsystem: append throughput per
-fsync policy, DurableEngine ingest overhead vs a bare engine, and recovery
-replay rate (host-only — not part of the BASELINE sweep). ``redelivery``
-measures amortized vote verification (VerifiedVoteCache + validated-chain
-watermark) under gossip redelivery and incremental chain growth, cache-on
-vs cache-off, with real ECDSA signatures.
+fleet | default | all (``all`` prints newline-separated JSON, one line
+per section). ``wal`` measures the durability subsystem: append
+throughput per fsync policy, DurableEngine ingest overhead vs a bare
+engine, and recovery replay rate (host-only — not part of the BASELINE
+sweep). ``redelivery`` measures amortized vote verification
+(VerifiedVoteCache + validated-chain watermark) under gossip redelivery
+and incremental chain growth, cache-on vs cache-off, with real ECDSA
+signatures. ``fleet`` measures the scope-sharded fleet
+(hashgraph_tpu.parallel.ConsensusFleet): an aggregate votes/sec headline
+across all local devices with a per-shard breakdown, a paired fleet-vs-
+single-shard A/B ``noise_verdict``, and a MULTICHIP-compatible record;
+``fleet --smoke`` is the 2-shard CI short run.
 
-``--compile-cache DIR`` enables JAX's persistent compilation cache at DIR
-(re-runs at the same geometry skip XLA compile warmup entirely).
+JAX's persistent compilation cache is ON BY DEFAULT at
+``~/.cache/hashgraph_tpu/xla-cache`` (re-runs at the same geometry skip
+XLA compile warmup entirely); ``--compile-cache DIR`` relocates it,
+``--no-compile-cache`` disables it. Multi-device CPU meshes default it
+off — the pinned jaxlib mis-deserializes cached multi-device CPU
+programs (wrong results + segfault; an explicit ``--compile-cache DIR``
+still forces it).
 
 ``--metrics-out PATH`` additionally snapshots the always-on observability
 registry (:mod:`hashgraph_tpu.obs` — counter totals, gauges, and histogram
@@ -1718,6 +1728,321 @@ def run_wal(
     }
 
 
+def run_fleet(
+    n_shards: int | None = None,
+    scopes_per_shard: int = 2,
+    p_count: int = 256,
+    v_count: int = 64,
+    reps: int = 3,
+    smoke: bool = False,
+) -> dict:
+    """Scope-sharded fleet throughput: one engine per local device, scopes
+    rendezvous-placed across them, a sustained mixed gossip+P2P columnar
+    workload routed by :class:`hashgraph_tpu.parallel.ConsensusFleet`, and
+    an AGGREGATE fleet votes/sec headline with a per-shard breakdown.
+
+    Paired same-window A/B (the PR-6 methodology): the fleet arm (all
+    shards) interleaves rep-for-rep with a single-shard arm (the same
+    per-shard workload confined to one shard) inside one window, and the
+    machine-readable ``noise_verdict`` refuses the scaling claim unless
+    the arms separate beyond the window's own spread. ``scaling`` is
+    aggregate-fleet / best-single-shard from the same window; on >= 4
+    distinct-device shards, near-linear means >= 3x (ISSUE 7 acceptance).
+
+    ``smoke`` shrinks to 2 shards x tiny shapes for the CI job: routing,
+    the psum tally path, and the sweep are exercised; the verdict is
+    reported but not asserted (2 CPU "devices" share one core).
+
+    Emits a ``MULTICHIP_*``-compatible record (``multichip_record``) so
+    the multichip artifact finally carries throughput, per-device slot
+    occupancy, and sweep seconds instead of just ``ok``/``tail``.
+    """
+    import jax
+
+    from hashgraph_tpu import (
+        CreateProposalRequest,
+        ScopeConfigBuilder,
+        StubConsensusSigner,
+    )
+    from hashgraph_tpu.parallel import ConsensusFleet
+
+    rng = np.random.default_rng(31)
+    now = 1_700_000_000
+    if smoke:
+        scopes_per_shard, p_count, v_count, reps = 1, 32, 16, 1
+        n_shards = 2 if n_shards is None else n_shards
+    n_devices = len(jax.devices())
+    if n_shards is None:
+        n_shards = n_devices
+    present = max(2, min(int(v_count * 0.7), (2 * v_count + 2) // 3 - 3))
+    capacity_per_shard = scopes_per_shard * p_count
+
+    fleet = ConsensusFleet(
+        lambda k: StubConsensusSigner(bytes([k + 1]) * 20),
+        n_shards=n_shards,
+        capacity_per_shard=capacity_per_shard,
+        voter_capacity=v_count,
+        max_sessions_per_scope=p_count + 1,
+    )
+    distinct_devices = len({str(fleet.shard(s).device) for s in fleet.shard_ids})
+
+    # Deterministically pick scopes_per_shard scope names per shard per
+    # rep epoch (rendezvous placement decides ownership; we just probe
+    # names until every shard's quota fills).
+    def pick_scopes(epoch: int, shard_ids) -> "dict[str, list[str]]":
+        got = {sid: [] for sid in shard_ids}
+        i = 0
+        while any(len(v) < scopes_per_shard for v in got.values()):
+            scope = f"e{epoch}-s{i}"
+            i += 1
+            sid = fleet.owner_of(scope)
+            if sid in got and len(got[sid]) < scopes_per_shard:
+                got[sid].append(scope)
+        return got
+
+    owners = [
+        bytes([1 + (i % 250), i // 250]) + b"\x00" * 18 for i in range(present)
+    ]
+    requests = [
+        CreateProposalRequest(
+            name="p",
+            payload=b"",
+            proposal_owner=b"o",
+            expected_voters_count=v_count,
+            expiration_timestamp=100,
+            liveness_criteria_yes=bool(rng.integers(2)),
+        )
+        for _ in range(p_count)
+    ]
+
+    def run_arm(epoch: int, shard_ids) -> dict:
+        """One rep of the sustained workload over ``shard_ids``' scopes:
+        register, columnar-ingest via the fleet router (mixed gossip/P2P
+        scopes, shuffled at proposal granularity), sweep, verify. Only
+        the ingest window feeds votes/sec (create/sweep timed apart)."""
+        by_shard = pick_scopes(epoch, shard_ids)
+        scopes = [s for group in by_shard.values() for s in group]
+        scope_shard = {
+            s: sid for sid, group in by_shard.items() for s in group
+        }
+        for i, scope in enumerate(scopes):
+            builder = ScopeConfigBuilder()
+            builder = (
+                builder.p2p_preset() if i % 2 else builder.gossipsub_preset()
+            )
+            fleet.set_scope_config(scope, builder.build())
+        t0 = time.perf_counter()
+        pids = {}
+        for scope in scopes:
+            pids[scope] = np.fromiter(
+                (
+                    p.proposal_id
+                    for p in fleet.create_proposals(scope, requests, now)
+                ),
+                np.int64,
+                p_count,
+            )
+        t_create = time.perf_counter()
+        gids = {
+            scope: np.array(
+                [fleet.voter_gid(scope, o) for o in owners], np.int64
+            )
+            for scope in scopes
+        }
+        # Proposal-major rows, scope-shuffled at proposal granularity.
+        all_pids = np.concatenate([pids[s] for s in scopes])
+        all_sidx = np.repeat(np.arange(len(scopes), dtype=np.int64), p_count)
+        order = rng.permutation(len(all_pids))
+        col_pids = np.repeat(all_pids[order], present)
+        col_sidx = np.repeat(all_sidx[order], present)
+        col_gids = np.concatenate(
+            [gids[scopes[k]] for k in all_sidx[order]]
+        )
+        col_vals = rng.random(len(col_pids)) < 0.5
+        t1 = time.perf_counter()
+        statuses = fleet.ingest_columnar_multi(
+            scopes, col_sidx, col_pids, col_gids, col_vals, now
+        )
+        t2 = time.perf_counter()
+        # Correctness gate every rep (run_engine_config5 discipline): a
+        # resolution/identity regression fails the bench, not the timer.
+        assert int(np.sum(statuses == 20)) == 0, "unresolved proposal ids"
+        assert int(np.sum(statuses == 10)) == 0, "stale voter gids"
+        applied = int(np.sum((statuses == 0) | (statuses == 28)))
+        assert applied >= int(0.9 * len(statuses)), (applied, len(statuses))
+        # Per-shard slice of the SAME concurrent window.
+        per_shard_votes = {sid: 0 for sid in shard_ids}
+        for k, scope in enumerate(scopes):
+            per_shard_votes[scope_shard[scope]] += int(
+                np.sum(all_sidx[order] == k)
+            ) * present
+        occupancy = fleet.occupancy()
+        t3 = time.perf_counter()
+        swept = fleet.sweep_timeouts(now + 200)
+        counts = fleet.fleet_state_counts()  # ONE psum (device path)
+        t4 = time.perf_counter()
+        for scope in scopes:
+            fleet.delete_scope(scope)
+        wall = t2 - t1
+        return {
+            "votes": len(statuses),
+            "votes_per_sec": round(len(statuses) / wall, 1),
+            "ingest_seconds": round(wall, 3),
+            "create_seconds": round(t_create - t0, 3),
+            "sweep_seconds": round(t4 - t3, 3),
+            "swept": len(swept),
+            "per_shard_votes_per_sec": {
+                sid: round(v / wall, 1) for sid, v in per_shard_votes.items()
+            },
+            "state_counts": {str(k): v for k, v in counts.items()},
+            "occupancy": occupancy,
+        }
+
+    all_shards = fleet.shard_ids
+    single = all_shards[:1]
+    # The single-shard arm repeats its scope-set workload ``single_waves``
+    # times per rep so both arms' timing windows are comparable in wall
+    # length (a 20 ms window is timer-jitter-bound; the fleet arm's window
+    # is naturally ~n_shards longer).
+    single_waves = max(1, min(n_shards, 4))
+
+    def run_single_rep(epoch_base: int) -> dict:
+        waves = [
+            run_arm(epoch_base + w, single) for w in range(single_waves)
+        ]
+        votes = sum(w["votes"] for w in waves)
+        seconds = sum(w["ingest_seconds"] for w in waves)
+        out = dict(waves[0])
+        out.update(
+            votes=votes,
+            ingest_seconds=round(seconds, 3),
+            votes_per_sec=round(votes / seconds, 1),
+        )
+        return out
+
+    # Warmup epoch (uncounted): compiles every shard's kernels at the
+    # production shapes for BOTH arms.
+    run_arm(0, all_shards)
+    run_arm(1, single)
+
+    fleet_reps: list[dict] = []
+    single_reps: list[dict] = []
+    epoch = 2
+    for _ in range(reps):
+        single_reps.append(run_single_rep(epoch))
+        epoch += single_waves
+        fleet_reps.append(run_arm(epoch, all_shards))
+        epoch += 1
+
+    def spread_pct(vals: "list[float]") -> float:
+        vals = sorted(vals)
+        mid = vals[len(vals) // 2]
+        return round(100.0 * (vals[-1] - vals[0]) / mid, 1) if mid else 0.0
+
+    fleet_rates = [r["votes_per_sec"] for r in fleet_reps]
+    single_rates = [r["votes_per_sec"] for r in single_reps]
+    headline_rep = sorted(fleet_reps, key=lambda r: r["votes_per_sec"])[
+        len(fleet_reps) // 2
+    ]
+    headline = headline_rep["votes_per_sec"]
+    best_single = max(single_rates)
+    scaling = round(headline / best_single, 2) if best_single else None
+    max_spread = max(spread_pct(fleet_rates), spread_pct(single_rates))
+    separated = min(fleet_rates) > max(single_rates)
+    outside_noise = (
+        scaling is not None and scaling > 1.0 + 2.0 * max_spread / 100.0
+    )
+    # The scaling CLAIM is only made on real parallel hardware: >= 4
+    # shards on >= 4 distinct non-CPU devices. Virtual CPU "devices"
+    # share the host's cores, so a single shard already saturates the
+    # substrate and aggregate/single is physically capped near 1x there —
+    # the bench still runs the A/B and reports the ratio, it just doesn't
+    # pretend shared cores are a scaling testbed.
+    shared_substrate = jax.devices()[0].platform == "cpu"
+    scaling_target = (
+        3.0
+        if (n_shards >= 4 and distinct_devices >= 4 and not shared_substrate)
+        else None
+    )
+    if scaling_target is not None:
+        # Real parallel hardware: the headline is trustworthy when the
+        # arms separate beyond the window's own weather (PR-6 criterion).
+        verdict_pass = bool(separated and outside_noise)
+        criterion = (
+            "min(fleet reps) > max(single-shard reps) AND "
+            "scaling > 1 + 2*max_spread"
+        )
+    else:
+        # No parallel-scaling claim to defend (shared CPU substrate, or
+        # too few shards/devices for the near-linear bar); the verdict
+        # gates the aggregate number's own reproducibility against
+        # BENCHMARKS.md's documented weather band.
+        reason = (
+            "shared substrate"
+            if shared_substrate
+            else "fewer than 4 shards on distinct devices"
+        )
+        verdict_pass = spread_pct(fleet_rates) < 33.3
+        criterion = f"no scaling claim ({reason}): fleet rep spread < 33%"
+    noise_verdict = {
+        "pass": verdict_pass,
+        "criterion": criterion,
+        "aggregate_votes_per_sec": headline,
+        "best_single_shard_votes_per_sec": best_single,
+        "scaling": scaling,
+        "scaling_target": scaling_target,
+        "scaling_pass": (
+            None if scaling_target is None else bool(scaling >= scaling_target)
+        ),
+        "shared_substrate": shared_substrate,
+        "fleet_reps": fleet_rates,
+        "single_shard_reps": single_rates,
+        "spread_pct": {
+            "fleet": spread_pct(fleet_rates),
+            "single": spread_pct(single_rates),
+        },
+    }
+    per_device_occupancy = [
+        occ
+        for sid in all_shards
+        for occ in headline_rep["occupancy"][sid]["per_device_slots_used"]
+    ]
+    multichip_record = {
+        "n_devices": n_devices,
+        "n_shards": n_shards,
+        "ok": True,
+        "votes_per_sec": headline,
+        "per_device_slot_occupancy": per_device_occupancy,
+        "sweep_seconds": headline_rep["sweep_seconds"],
+        "votes": headline_rep["votes"],
+        "tally_path": "psum" if fleet._tally() is not None else "host-sum",
+    }
+    fleet.close()
+    return {
+        "metric": "fleet_aggregate_ingest_throughput",
+        "value": headline,
+        "unit": "votes/sec",
+        "vs_baseline": round(headline / 1_000_000, 4),
+        "detail": {
+            "n_shards": n_shards,
+            "n_devices": n_devices,
+            "distinct_devices": distinct_devices,
+            "scopes_per_shard": scopes_per_shard,
+            "proposals_per_scope": p_count,
+            "voters": v_count,
+            "present": present,
+            "smoke": smoke,
+            "per_shard": headline_rep["per_shard_votes_per_sec"],
+            "sweep_seconds": headline_rep["sweep_seconds"],
+            "swept": headline_rep["swept"],
+            "state_counts": headline_rep["state_counts"],
+            "noise_verdict": noise_verdict,
+            "multichip_record": multichip_record,
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
 def run_default() -> dict:
     """The driver-visible sweep: engine-level config 3 as the headline,
     every other BASELINE shape in ``detail`` (one JSON line total).
@@ -1799,13 +2124,97 @@ if __name__ == "__main__":
     # anomaly rule should say so in the artifact, not just in a side file.
     health_out = _pop_flag("--health-out")
 
-    # --compile-cache DIR: enable JAX's persistent compilation cache so a
-    # re-run at the same geometry skips XLA compiles (BENCH_r05 measured
-    # 147.7 s of compile warmup in engine_config4 alone). Thresholds are
-    # zeroed so every program is cached, tiny ones included — the bench's
-    # many small dispatch shapes are exactly the ones worth keeping.
+    # fleet --smoke: the CI topology — 2 simulated shards on virtual CPU
+    # devices (the conftest trick), exercising routing + the psum tally on
+    # boxes with one physical device. Must run before anything initializes
+    # the jax backend (incl. the compile-cache default logic below, which
+    # reads the device topology); if the backend already initialized
+    # (e.g. this interpreter's sitecustomize compiled on the real chip),
+    # the fleet falls back to shards sharing a device and says so in
+    # ``tally_path``.
+    fleet_smoke = "--smoke" in args
+    if fleet_smoke:
+        args.remove("--smoke")
+        import os as _os
+
+        _flags = _os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            _os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+
+    # --compile-cache DIR: JAX's persistent compilation cache, ON BY
+    # DEFAULT (BENCH_r05 measured 147.7 s of compile warmup in
+    # engine_config4 alone; a re-run at the same geometry should never
+    # pay it twice). Default location is per-user
+    # (~/.cache/hashgraph_tpu/xla-cache); pass --compile-cache DIR to
+    # relocate or --no-compile-cache to opt out (e.g. when measuring
+    # compile time itself). Thresholds are zeroed so every program is
+    # cached, tiny ones included — the bench's many small dispatch shapes
+    # are exactly the ones worth keeping.
+    #
+    # EXCEPTION (defaulted off, explicit flag still wins): multi-device
+    # CPU meshes. On the pinned jaxlib, programs deserialized from the
+    # persistent cache under --xla_force_host_platform_device_count>1
+    # return WRONG RESULTS and segfault at teardown (reproduced with the
+    # fleet's shard_map kernels: corrupted psum tallies, 1936/2048 OK
+    # rows on a batch that applies 2048/2048 cold — see BENCHMARKS.md
+    # "Fleet" methodology note). Single-device CPU and TPU paths verify
+    # clean, so only the known-bad combination opts out.
     compile_cache = _pop_flag("--compile-cache")
-    if compile_cache is not None:
+    no_compile_cache = "--no-compile-cache" in args
+    if no_compile_cache:
+        args.remove("--no-compile-cache")
+        if compile_cache is not None:
+            raise SystemExit(
+                "--compile-cache and --no-compile-cache are mutually exclusive"
+            )
+
+    def _setup_compile_cache(which: str) -> None:
+        """Resolve + activate the compile-cache default. Deferred until
+        the mode is known: the default-enable decision probes the device
+        topology, which initializes the accelerator backend — a cost the
+        host-only modes (pure filesystem / host crypto, zero XLA
+        programs) must not pay just for arg parsing."""
+        global compile_cache
+        import os
+
+        if no_compile_cache:
+            return
+        if compile_cache is None:
+            if which in ("wal", "crypto"):
+                return  # host-only: nothing to cache
+            import jax
+
+            devices = jax.devices()
+            if devices[0].platform == "cpu" and len(devices) > 1:
+                print(
+                    "compile cache left off: multi-device CPU meshes "
+                    "mis-deserialize cached programs on this jaxlib "
+                    "(wrong tallies + teardown segfault); pass "
+                    "--compile-cache DIR to force",
+                    file=sys.stderr,
+                )
+                return
+            compile_cache = os.path.join(
+                os.path.expanduser("~"), ".cache", "hashgraph_tpu", "xla-cache"
+            )
+            try:
+                os.makedirs(compile_cache, exist_ok=True)
+            except OSError as exc:
+                print(
+                    f"compile cache disabled ({exc}); pass --compile-cache "
+                    "DIR for a writable location",
+                    file=sys.stderr,
+                )
+                compile_cache = None
+                return
         import jax
 
         jax.config.update("jax_compilation_cache_dir", compile_cache)
@@ -1856,6 +2265,7 @@ if __name__ == "__main__":
               file=sys.stderr)
 
     which = args[0] if args else "default"
+    _setup_compile_cache(which)
     runners = {
         "engine": run_engine_bench,
         "pool": run_bench,
@@ -1875,6 +2285,7 @@ if __name__ == "__main__":
         "validated_sweep": run_validated_sweep,  # shell-friendly alias
         "redelivery": run_redelivery,
         "wal": run_wal,
+        "fleet": lambda: run_fleet(smoke=fleet_smoke),
         "default": run_default,
     }
     def _registry_snapshot() -> dict:

@@ -3391,6 +3391,23 @@ class TpuConsensusEngine(Generic[Scope]):
         out["identity"] = self._signer.identity().hex()
         return out
 
+    def occupancy(self) -> dict:
+        """Capacity snapshot: live sessions, device slots claimed vs pool
+        capacity, and host-spilled sessions (negative synthetic ids hold
+        no pool row). The same numbers the scrape-time gauges sample,
+        exposed as one consistent read for fleet routers and capacity
+        planners (parallel.fleet's per-shard breakdown)."""
+        with self._lock:
+            slots = list(self._records)
+        device_used = sum(1 for s in slots if s >= 0)
+        return {
+            "live_sessions": len(slots),
+            "device_slots_used": device_used,
+            "host_spilled": len(slots) - device_used,
+            "capacity": self._pool.capacity,
+            "voter_capacity": self._pool.voter_capacity,
+        }
+
     def export_session(self, scope: Scope, proposal_id: int) -> ConsensusSession:
         """Materialise a scalar ConsensusSession from the pooled state —
         the bridge back to ConsensusStorage backends (checkpoint/interop).

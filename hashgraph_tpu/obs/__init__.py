@@ -149,6 +149,15 @@ JAX_LIVE_BUFFER_BYTES = "hashgraph_jax_live_buffer_bytes"
 JAX_COMPILE_CACHE_HITS_TOTAL = "hashgraph_jax_compile_cache_hits_total"
 JAX_COMPILE_CACHE_MISSES_TOTAL = "hashgraph_jax_compile_cache_misses_total"
 
+# Scope-sharded fleet (parallel.fleet): shard-count gauges, the router's
+# per-shard vote counter (fleets add labelled variants, e.g.
+# hashgraph_fleet_routed_votes_total{shard="shard-0"}), and the
+# fleet-wide sweep latency.
+FLEET_SHARDS = "hashgraph_fleet_shards"
+FLEET_SHARDS_RECOVERING = "hashgraph_fleet_shards_recovering"
+FLEET_ROUTED_VOTES_TOTAL = "hashgraph_fleet_routed_votes_total"
+FLEET_SWEEP_SECONDS = "hashgraph_fleet_sweep_seconds"
+
 # Process-wide default registry (mirrors tracing.tracer's role).
 registry = MetricsRegistry()
 
@@ -163,6 +172,7 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         DEVICE_INGEST_SECONDS,
         WAL_FSYNC_SECONDS,
         WAL_RECOVER_SECONDS,
+        FLEET_SWEEP_SECONDS,
     ):
         reg.histogram(name, DEFAULT_TIME_BUCKETS)
     reg.histogram(INGEST_BATCH_SIZE, DEFAULT_SIZE_BUCKETS)
@@ -174,6 +184,8 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         WAL_SEGMENT_BYTES,
         JAX_LIVE_BUFFER_BYTES,
         VERIFY_POOL_QUEUE_DEPTH,
+        FLEET_SHARDS,
+        FLEET_SHARDS_RECOVERING,
         TRACKED_PEERS,
         EVIDENCE_RECORDS,
         STALE_PEERS,
@@ -201,6 +213,7 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         EXPIRED_GOSSIP_TOTAL,
         JAX_COMPILE_CACHE_HITS_TOTAL,
         JAX_COMPILE_CACHE_MISSES_TOTAL,
+        FLEET_ROUTED_VOTES_TOTAL,
     ):
         reg.counter(name)
     reg.info(BUILD_INFO).set(

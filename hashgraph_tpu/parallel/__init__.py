@@ -6,6 +6,13 @@ vectors are small); the host-validate → device-tally split is the pipeline
 axis. Collectives (psum over ICI) appear only in global aggregation.
 """
 
+from .fleet import (
+    ConsensusFleet,
+    FleetShard,
+    ScopePlacement,
+    ShardRecoveringError,
+    rendezvous_owner,
+)
 from .mesh import PROPOSAL_AXIS, consensus_mesh
 from .multihost import (
     MultiHostPool,
@@ -25,4 +32,9 @@ __all__ = [
     "initialize_distributed",
     "distributed_consensus_mesh",
     "local_slot_range",
+    "ConsensusFleet",
+    "FleetShard",
+    "ScopePlacement",
+    "ShardRecoveringError",
+    "rendezvous_owner",
 ]

@@ -358,6 +358,14 @@ class ShardedPool(ProposalPool):
 
     # ── Collectives ────────────────────────────────────────────────────
 
+    def per_device_occupancy(self) -> list[int]:
+        """Occupied (non-FREE) slots per mesh device, from the host state
+        mirror — the per-device view the MULTICHIP artifact and the fleet
+        bench's per-shard breakdown report. Device ``d`` owns the
+        contiguous block ``[d·local_capacity, (d+1)·local_capacity)``."""
+        blocks = self._state_host.reshape(self.n_devices, self.local_capacity)
+        return (blocks != STATE_FREE).sum(axis=1).astype(int).tolist()
+
     def global_state_counts(self) -> dict[int, int]:
         """Device-side global histogram of slot states via psum over ICI
         (the all-reduce the host mirror makes redundant for small pools, but

@@ -215,7 +215,13 @@ class DurableEngine:
 
     # ── Recovery ───────────────────────────────────────────────────────
 
-    def recover(self, storage=None, *, after_lsn: "int | None" = None) -> ReplayStats:
+    def recover(
+        self,
+        storage=None,
+        *,
+        after_lsn: "int | None" = None,
+        on_record=None,
+    ) -> ReplayStats:
         """Rebuild the wrapped engine from the WAL (and optionally a
         snapshot): with ``storage``, loads it first and replays only records
         the snapshot does not cover; without, replays every surviving
@@ -233,7 +239,12 @@ class DurableEngine:
         exactly the records that snapshot covers. (Over-replay is safe — a
         watermark older than the snapshot just re-ingests records the
         engine rejects as duplicates — so when unsure, pass a smaller
-        ``after_lsn``.)"""
+        ``after_lsn``.)
+
+        ``on_record(lsn, kind)`` forwards to
+        :func:`~hashgraph_tpu.wal.recovery.replay` — replay-progress
+        observation for long logs (a fleet supervisor reporting a
+        recovering shard's position)."""
         with self._lock:
             start = time.perf_counter()
             # Replay-mode metrics gate (engines without one — this module
@@ -249,6 +260,7 @@ class DurableEngine:
                         self._wal.directory,
                         self._engine,
                         after_lsn=0 if after_lsn is None else after_lsn,
+                        on_record=on_record,
                     )
                 else:
                     self._engine.load_from_storage(storage)
@@ -257,7 +269,10 @@ class DurableEngine:
                     # metadata pass and streams the tail one segment at a
                     # time).
                     stats = replay(
-                        self._wal.directory, self._engine, after_lsn=after_lsn
+                        self._wal.directory,
+                        self._engine,
+                        after_lsn=after_lsn,
+                        on_record=on_record,
                     )
             finally:
                 if set_mode is not None:

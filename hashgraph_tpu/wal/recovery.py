@@ -116,6 +116,7 @@ def replay(
     *,
     after_lsn: "int | None" = 0,
     tracer=None,
+    on_record=None,
 ) -> ReplayStats:
     """Replay a WAL (directory path or a prior :func:`scan`) into ``engine``.
 
@@ -134,6 +135,12 @@ def replay(
     The engine will emit events for replayed transitions exactly as live
     traffic would; attach/subscribe the event bus AFTER recovery unless the
     embedder wants the replayed stream.
+
+    ``on_record(lsn, kind)`` (optional) is invoked before each surviving
+    record is applied — replay progress observation for long logs (a
+    fleet supervisor reporting a recovering shard's position, or a test
+    holding a replay mid-flight to assert other shards keep serving).
+    Exceptions from the callback abort the replay.
     """
     tr = tracer if tracer is not None else default_tracer
     log_watermark = 0  # marks the probe saw beyond forward-reachable ones
@@ -144,6 +151,8 @@ def replay(
         stats = ReplayStats()
         for records in _iter_intact(source, meta):
             for lsn, kind, payload in records:
+                if on_record is not None:
+                    on_record(lsn, kind)
                 _replay_record(engine, lsn, kind, payload, after_lsn, stats, tr)
     else:
         meta = source
@@ -151,6 +160,8 @@ def replay(
             after_lsn = meta.watermark
         stats = ReplayStats()
         for lsn, kind, payload in meta.records:
+            if on_record is not None:
+                on_record(lsn, kind)
             _replay_record(engine, lsn, kind, payload, after_lsn, stats, tr)
     stats.last_lsn = meta.last_lsn
     stats.watermark = max(meta.watermark, log_watermark)

