@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet fleet-smoke metrics-smoke trace-smoke smoke
+.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-catchup fleet-smoke catchup-smoke metrics-smoke trace-smoke smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -43,6 +43,18 @@ bench-fleet:
 # fleet routing, the psum tally path, and the sweep on every PR.
 fleet-smoke:
 	JAX_PLATFORMS=cpu python bench.py fleet --smoke
+
+# State-sync catch-up bench: snapshot+tail vs full WAL replay at several
+# history lengths, paired same-window A/B with a machine-readable
+# noise_verdict, per-rep byte-identical convergence asserts.
+bench-catchup:
+	python bench.py catchup
+
+# CI short run: two in-process peers over a real bridge, small signed
+# history, snapshot+tail AND full-replay joiners both asserted
+# byte-identical to the source, interrupted-transfer resume included.
+catchup-smoke:
+	JAX_PLATFORMS=cpu python examples/catchup_smoke.py
 
 # End-to-end observability check: start a bridge server (WAL + HTTP
 # sidecar), drive a proposal to decision, scrape /metrics + /healthz and

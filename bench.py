@@ -1728,6 +1728,235 @@ def run_wal(
     }
 
 
+def run_catchup(
+    history_votes: "tuple[int, ...]" = (256, 1024, 4096),
+    v_count: int = 16,
+    wave: int = 8,
+    reps: int = 3,
+    smoke: bool = False,
+) -> dict:
+    """State-sync catch-up: snapshot+tail vs full WAL replay, paired
+    same-window A/B at several history lengths (ROADMAP 4 + 5b).
+
+    A source peer on a real BridgeServer accumulates a signed vote
+    history in gossip-sized waves (``wave`` votes per record — the
+    realistic replay granularity: full replay re-verifies at that batch
+    size, while the snapshot path verifies the whole history in ONE
+    batched pool pass). Per history length, ``reps`` interleaved rep
+    pairs each catch a FRESH joiner up twice over the wire:
+
+    - **A (baseline)**: ``CatchUpClient.full_replay`` — stream the whole
+      WAL, per-record validation (O(history) crypto);
+    - **B (headline)**: ``CatchUpClient.catch_up`` — manifest + chunks
+      (digest-checked), one batched chain/signature verify, atomic
+      install, then tail the post-snapshot suffix. The source's snapshot
+      is invalidated between reps (a sweep record moves the watermark)
+      so every B rep pays the full snapshot build + transfer + verify,
+      not a cached manifest.
+
+    Every rep asserts byte-identical convergence
+    (``sync.state_fingerprint`` equality of joiner vs source) before its
+    time counts. The ``noise_verdict`` (at the largest history) refuses
+    the claim unless the arms separate beyond the window's own spread,
+    with a fixed host-crypto control timed between reps as the weather
+    normalizer. Headline: catch-up seconds + verified votes/sec at the
+    largest history.
+    """
+    import os
+    import tempfile
+
+    from hashgraph_tpu import build_vote
+    from hashgraph_tpu import native
+    from hashgraph_tpu.bridge.client import BridgeClient
+    from hashgraph_tpu.bridge.server import BridgeServer
+    from hashgraph_tpu.engine import TpuConsensusEngine
+    from hashgraph_tpu.signing.ed25519 import Ed25519ConsensusSigner
+    from hashgraph_tpu.sync import CatchUpClient, state_fingerprint
+    from hashgraph_tpu.wire import Proposal
+
+    if smoke:
+        history_votes = (64,)
+        reps = 2
+    now = 1_700_000_000
+    scheme = Ed25519ConsensusSigner
+
+    # Host-crypto control: fixed batch-verify workload timed between A/B
+    # reps — the shared-host weather normalizer (BENCHMARKS.md).
+    ctl_signers = [scheme.random() for _ in range(8)]
+    ctl_payloads = [b"ctl-%d" % i for i in range(1024)]
+    ctl_sigs = [ctl_signers[i % 8].sign(p) for i, p in enumerate(ctl_payloads)]
+    ctl_ids = [ctl_signers[i % 8].identity() for i in range(1024)]
+
+    def control_rate() -> float:
+        """Median of three back-to-back runs: one control point should
+        track the window's crypto weather, not a single scheduler
+        preemption (isolated runs show rare 2x dips on shared hosts)."""
+        rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            verdicts = scheme.verify_batch(ctl_ids, ctl_payloads, ctl_sigs)
+            assert all(v is True for v in verdicts)
+            rates.append(1024 / (time.perf_counter() - t0))
+        return round(sorted(rates)[1], 1)
+
+    def spread_pct(vals: "list[float]") -> float:
+        vals = sorted(vals)
+        mid = vals[len(vals) // 2]
+        return round(100.0 * (vals[-1] - vals[0]) / mid, 1) if mid else 0.0
+
+    def fresh_joiner(capacity: int) -> TpuConsensusEngine:
+        return TpuConsensusEngine(
+            scheme.random(),
+            capacity=capacity,
+            voter_capacity=v_count + 2,
+        )
+
+    def build_history(client, peer, total: int) -> int:
+        """Drive ``total`` chained signed votes (spread over
+        total/v_count proposals) through the bridge; returns the
+        proposal count."""
+        p_count = max(1, total // v_count)
+        signers = [scheme.random() for _ in range(v_count)]
+        for p in range(p_count):
+            # One scope per proposal: the history must be RETAINED (the
+            # engine's per-scope session cap would otherwise evict early
+            # sessions, shrinking the very state catch-up ships).
+            scope = f"scope-{p}"
+            pid, blob = client.create_proposal(
+                peer, scope, now, f"p{p}", b"payload", v_count + 1, 3_600
+            )
+            proposal = Proposal.decode(blob)
+            batch: list[bytes] = []
+            for signer in signers:
+                vote = build_vote(proposal, True, signer, now + 1)
+                proposal.votes.append(vote)
+                batch.append(vote.encode())
+                if len(batch) == wave:
+                    client.process_votes(peer, scope, batch, now + 1)
+                    batch = []
+            if batch:
+                client.process_votes(peer, scope, batch, now + 1)
+        return p_count
+
+    lengths: list[dict] = []
+    with tempfile.TemporaryDirectory() as root:
+        for total in history_votes:
+            server = BridgeServer(
+                capacity=max(64, total // v_count + 8),
+                voter_capacity=v_count + 2,
+                wal_dir=os.path.join(root, f"wal-{total}"),
+                wal_fsync="off",  # catch-up reads the log; fsync is not under test
+                signer_factory=scheme,  # peers verify the Ed25519 votes
+            )
+            with server:
+                host, port = server.address
+                with BridgeClient(host, port) as client:
+                    key = os.urandom(32)
+                    peer, identity = client.add_peer(key)
+                    p_count = build_history(client, peer, total)
+                    source = server.durable_engine(identity)
+                    src_fp = state_fingerprint(source)
+                    capacity = max(64, p_count + 8)
+
+                    # Untimed warmup pair: both arms' one-time costs (jit
+                    # at these shapes, Ed25519 table builds, snapshot
+                    # build) land here, not on the first timed rep.
+                    with CatchUpClient(host, port, peer) as cu:
+                        cu.full_replay(fresh_joiner(capacity))
+                    with CatchUpClient(host, port, peer) as cu:
+                        cu.catch_up(fresh_joiner(capacity))
+
+                    a_seconds: list[float] = []
+                    b_seconds: list[float] = []
+                    b_votes_verified = 0
+                    # Per-length control window: the verdict compares the
+                    # weather DURING these reps, not across the whole
+                    # sweep (earlier lengths' samples would inflate the
+                    # spread without describing this window).
+                    controls: list[float] = [control_rate()]
+                    for _ in range(reps):
+                        joiner = fresh_joiner(capacity)
+                        with CatchUpClient(host, port, peer) as cu:
+                            rep = cu.full_replay(joiner)
+                        assert state_fingerprint(joiner) == src_fp, (
+                            "full replay diverged"
+                        )
+                        a_seconds.append(rep.seconds)
+                        controls.append(control_rate())
+
+                        # Move the watermark so THIS rep's manifest is a
+                        # fresh snapshot build, not a cached artifact.
+                        source.sweep_timeouts(now + 2)
+                        src_fp = state_fingerprint(source)
+                        joiner = fresh_joiner(capacity)
+                        with CatchUpClient(host, port, peer) as cu:
+                            rep = cu.catch_up(joiner)
+                        assert state_fingerprint(joiner) == src_fp, (
+                            "snapshot+tail diverged"
+                        )
+                        b_seconds.append(rep.seconds)
+                        b_votes_verified = rep.votes_verified + rep.tail_votes
+                        controls.append(control_rate())
+
+                    med_a = sorted(a_seconds)[len(a_seconds) // 2]
+                    med_b = sorted(b_seconds)[len(b_seconds) // 2]
+                    lengths.append({
+                        "history_votes": total,
+                        "proposals": p_count,
+                        "wal_last_lsn": source.wal.last_lsn,
+                        "replay_seconds": a_seconds,
+                        "catchup_seconds": b_seconds,
+                        "replay_votes_per_sec": round(total / med_a, 1),
+                        "catchup_votes_per_sec": round(total / med_b, 1),
+                        "votes_verified": b_votes_verified,
+                        "speedup": round(med_a / med_b, 2),
+                        "control_sigs_per_sec": controls,
+                    })
+
+    largest = lengths[-1]
+    a_reps = largest["replay_seconds"]
+    b_reps = largest["catchup_seconds"]
+    controls = largest["control_sigs_per_sec"]
+    med_a = sorted(a_reps)[len(a_reps) // 2]
+    med_b = sorted(b_reps)[len(b_reps) // 2]
+    speedup = round(med_a / med_b, 2)
+    max_spread = max(spread_pct(a_reps), spread_pct(b_reps), spread_pct(controls))
+    separated = max(b_reps) < min(a_reps)
+    outside_noise = speedup > 1.0 + 2.0 * max_spread / 100.0
+    noise_verdict = {
+        "pass": bool(separated and outside_noise),
+        "criterion": (
+            "max(catchup reps) < min(replay reps) AND "
+            "speedup > 1 + 2*max_spread (largest history)"
+        ),
+        "history_votes": largest["history_votes"],
+        "catchup_seconds": med_b,
+        "replay_seconds": med_a,
+        "speedup": speedup,
+        "catchup_reps": b_reps,
+        "replay_reps": a_reps,
+        "control_sigs_per_sec": controls,
+        "spread_pct": {
+            "catchup": spread_pct(b_reps),
+            "replay": spread_pct(a_reps),
+            "control": spread_pct(controls),
+        },
+    }
+    return {
+        "metric": "catchup_verified_votes_per_sec",
+        "value": largest["catchup_votes_per_sec"],
+        "unit": "votes/sec",
+        "detail": {
+            "scheme": "ed25519",
+            "native_runtime": native.available(),
+            "wave_votes_per_record": wave,
+            "catchup_seconds_headline": med_b,
+            "lengths": lengths,
+            "noise_verdict": noise_verdict,
+        },
+    }
+
+
 def run_fleet(
     n_shards: int | None = None,
     scopes_per_shard: int = 2,
@@ -2286,6 +2515,7 @@ if __name__ == "__main__":
         "redelivery": run_redelivery,
         "wal": run_wal,
         "fleet": lambda: run_fleet(smoke=fleet_smoke),
+        "catchup": lambda: run_catchup(smoke=fleet_smoke),
         "default": run_default,
     }
     def _registry_snapshot() -> dict:

@@ -56,6 +56,14 @@ REQUIRED_FAMILIES = [
     "hashgraph_verify_pool_queue_depth",
     "hashgraph_verified_signatures_total",
     'hashgraph_verified_signatures_total{scheme="',
+    # State-sync families: snapshot chunks served/received, WAL tail
+    # records applied, end-to-end catch-up seconds (histogram). Eagerly
+    # installed so a dashboard sees them before the first catch-up; the
+    # traffic itself is exercised by examples/catchup_smoke.py.
+    "hashgraph_sync_chunks_sent_total",
+    "hashgraph_sync_chunks_received_total",
+    "hashgraph_sync_tail_records_total",
+    "hashgraph_sync_catchup_seconds_bucket",
 ]
 
 

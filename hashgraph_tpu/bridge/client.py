@@ -297,6 +297,57 @@ class BridgeClient:
         )
         return json.loads(cursor.blob().decode("utf-8"))
 
+    def sync_manifest(self, peer: int, max_chunk_bytes: int = 0) -> dict:
+        """State-sync snapshot manifest for a durable peer
+        (``OP_SYNC_MANIFEST``): the snapshot's identity (``snapshot_id``),
+        its WAL ``watermark`` LSN, transfer geometry (``total_bytes``,
+        ``chunk_bytes``, ``chunk_count``), item counts, and per-chunk
+        SHA-256 ``digests``. ``max_chunk_bytes`` caps the server's chunk
+        size (0 = server default). Raises BridgeError(241) for
+        undurable peers."""
+        cursor = self._call(
+            P.OP_SYNC_MANIFEST, P.u32(peer) + P.u32(max_chunk_bytes)
+        )
+        manifest = {
+            "snapshot_id": cursor.u64(),
+            "watermark": cursor.u64(),
+            "total_bytes": cursor.u64(),
+            "chunk_bytes": cursor.u32(),
+            "session_count": cursor.u32(),
+            "config_count": cursor.u32(),
+        }
+        count = cursor.u32()
+        manifest["chunk_count"] = count
+        manifest["digests"] = [cursor.raw(32) for _ in range(count)]
+        return manifest
+
+    def sync_chunk(self, peer: int, snapshot_id: int, index: int) -> bytes:
+        """One snapshot chunk (``OP_SYNC_CHUNK``). Raises
+        BridgeError(``P.STATUS_SYNC_STALE``) when the identified snapshot
+        is no longer served — re-fetch the manifest and resume from the
+        chunks already verified."""
+        return self._call(
+            P.OP_SYNC_CHUNK, P.u32(peer) + P.u64(snapshot_id) + P.u32(index)
+        ).blob()
+
+    def wal_tail(
+        self, peer: int, after_lsn: int, max_bytes: int = 0
+    ) -> "tuple[list[tuple[int, int, bytes]], bool]":
+        """WAL records after ``after_lsn`` (``OP_WAL_TAIL``): returns
+        ``(records, more)`` with records as ``(lsn, kind, payload)`` in
+        log order; ``more`` means the server's byte budget stopped the
+        read short — loop with ``after_lsn`` advanced to the last
+        received LSN."""
+        cursor = self._call(
+            P.OP_WAL_TAIL, P.u32(peer) + P.u64(after_lsn) + P.u32(max_bytes)
+        )
+        records = []
+        for _ in range(cursor.u32()):
+            lsn = cursor.u64()
+            kind = cursor.u8()
+            records.append((lsn, kind, cursor.blob()))
+        return records, bool(cursor.u8())
+
     def get_metrics(self) -> str:
         """Prometheus text-format scrape of the server process's metrics
         registry (server-wide — no peer id). The same text the HTTP

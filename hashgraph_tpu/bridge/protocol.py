@@ -77,12 +77,33 @@ OP_EXPLAIN = 13
 # grades, self-authenticating equivocation/fork evidence, liveness
 # watchdog, firing alert rules; durable peers overlay the WAL watermark).
 OP_HEALTH = 14
+# ── State sync (snapshot shipping + WAL tailing; durable peers only) ──
+# SYNC_MANIFEST: u32 peer_id + u32 max_chunk_bytes (0 = server default)
+# -> u64 snapshot_id | u64 watermark_lsn | u64 total_bytes |
+#    u32 chunk_bytes | u32 session_count | u32 config_count |
+#    u32 chunk_count | chunk_count × 32-byte SHA-256 chunk digests.
+# The server captures (or reuses, when the WAL position is unchanged) a
+# consistent snapshot of the peer's state at its WAL watermark; chunks
+# are byte ranges of the serialized snapshot (sync.snapshot format).
+OP_SYNC_MANIFEST = 15
+# SYNC_CHUNK: u32 peer_id + u64 snapshot_id + u32 chunk_index -> one
+# byte blob (that chunk of the snapshot). STATUS_SYNC_STALE means the
+# identified snapshot is no longer served (the source's state moved on
+# and the snapshot was rebuilt) — re-fetch the manifest and resume.
+OP_SYNC_CHUNK = 16
+# WAL_TAIL: u32 peer_id + u64 after_lsn + u32 max_bytes ->
+# u32 count | count × (u64 lsn | u8 kind | u32 len | record payload) |
+# u8 more. Streams the peer's WAL records after ``after_lsn`` in log
+# order, resumable by advancing after_lsn to the last received LSN;
+# ``more`` = 1 when the byte budget stopped the read short.
+OP_WAL_TAIL = 17
 
 # Bridge-level statuses (protocol StatusCode values occupy 0..29).
 STATUS_OK = 0
 STATUS_UNKNOWN_PEER = 240
 STATUS_BAD_REQUEST = 241
 STATUS_UNKNOWN_OPCODE = 242
+STATUS_SYNC_STALE = 245  # requested snapshot_id no longer served
 STATUS_INTERNAL = 250
 
 # GET_RESULT payload byte.

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import socket
 import threading
 import time
@@ -29,6 +30,7 @@ from ..events import BroadcastEventBus, EventReceiver
 from ..obs import (
     BRIDGE_ERRORS_TOTAL,
     BRIDGE_REQUESTS_TOTAL,
+    SYNC_CHUNKS_SENT_TOTAL,
     HealthMonitor,
     MetricsSidecar,
     flight_recorder,
@@ -118,12 +120,23 @@ class BridgeServer:
         metrics_host: str = "127.0.0.1",
         verify_cache: "VerifiedVoteCache | None | str" = "shared",
         health_monitor: "HealthMonitor | None" = None,
+        signer_factory: type | None = None,
     ):
         self._host = host
         self._port = port
         self._capacity = capacity
         self._voter_capacity = voter_capacity
         self._engine_factory = engine_factory
+        # Scheme the ADD_PEER opcode mints signers from (all peers on a
+        # network must share one scheme, reference src/signing.rs:46-74):
+        # any ConsensusSignatureScheme class with ``random()`` and a
+        # 32-byte-key constructor works — EthereumConsensusSigner
+        # (default, the reference's scheme) or Ed25519ConsensusSigner
+        # (batch-verified; the state-sync/catch-up benches use it).
+        self._signer_factory = (
+            signer_factory if signer_factory is not None
+            else EthereumConsensusSigner
+        )
         # ONE admission cache for every peer engine this server builds
         # ("shared", the default): co-hosted peers receive the same
         # gossiped votes, so a vote is ECDSA-verified once per server
@@ -189,6 +202,20 @@ class BridgeServer:
         self._sidecar: MetricsSidecar | None = None
         self._m_requests = default_registry.counter(BRIDGE_REQUESTS_TOTAL)
         self._m_errors = default_registry.counter(BRIDGE_ERRORS_TOTAL)
+        # State sync: per-peer cached snapshot (manifest, file path),
+        # rebuilt when the peer's WAL position (or the requested chunk
+        # geometry) moves. ``_sync_lock`` guards only the cache dict and
+        # the id counter; per-peer gates serialize builds so one peer's
+        # multi-second snapshot capture never stalls another peer's
+        # manifest or chunk traffic. Snapshot ids are unique PER BUILD
+        # (never reused across rebuilds, even at an unchanged watermark),
+        # so a client holding a stale manifest always gets
+        # STATUS_SYNC_STALE rather than chunks from a different artifact.
+        self._sync_cache: dict[int, tuple[object, str]] = {}
+        self._sync_gates: dict[int, threading.Lock] = {}
+        self._sync_lock = threading.Lock()
+        self._sync_seq = 0
+        self._m_sync_chunks = default_registry.counter(SYNC_CHUNKS_SENT_TOTAL)
 
     # ── lifecycle ──────────────────────────────────────────────────────
 
@@ -335,6 +362,18 @@ class BridgeServer:
                 del self._peers[peer_id]
         for engine in durable:
             engine.close()
+        # Served snapshots die with the server: the files live under the
+        # peers' WAL directories and would otherwise accumulate one stale
+        # artifact per incarnation.
+        with self._sync_lock:
+            sync_paths = [path for _, path in self._sync_cache.values()]
+            self._sync_cache.clear()
+            self._sync_gates.clear()
+        for path in sync_paths:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
         if self._sidecar is not None:
             self._sidecar.stop()
             self._sidecar = None
@@ -438,9 +477,9 @@ class BridgeServer:
     def _op_add_peer(self, c: P.Cursor) -> tuple[int, bytes]:
         keylen = c.u8()
         if keylen == 0:
-            signer: ConsensusSignatureScheme = EthereumConsensusSigner.random()
+            signer: ConsensusSignatureScheme = self._signer_factory.random()
         elif keylen == 32:
-            signer = EthereumConsensusSigner(c.raw(32))
+            signer = self._signer_factory(c.raw(32))
         else:
             return P.STATUS_BAD_REQUEST, P.string("key must be absent or 32 bytes")
         identity = signer.identity()
@@ -530,6 +569,15 @@ class BridgeServer:
                 self._recovery[identity] = stats
                 self._durable[identity] = engine
             return engine
+
+    def durable_engine(self, identity: bytes):
+        """The live :class:`~hashgraph_tpu.wal.DurableEngine` backing
+        ``identity``'s peer (None = identity unknown or not durable).
+        Embedders use it for checkpoint scheduling and state-sync
+        bookkeeping; tests use it to reach the source engine behind a
+        bridged peer."""
+        with self._lock:
+            return self._durable.get(identity)
 
     def recovery_stats(self, identity: bytes):
         """:class:`~hashgraph_tpu.wal.ReplayStats` from the WAL recovery
@@ -705,6 +753,142 @@ class BridgeServer:
         report = peer.engine.health_report(now if now else None)
         return P.STATUS_OK, P.blob(json.dumps(report).encode("utf-8"))
 
+    # ── State sync: snapshot shipping + WAL tailing ────────────────────
+
+    # Server-side bounds: a chunk must fit one response frame with room
+    # to spare; the tail budget caps how much log one response carries.
+    _SYNC_MAX_CHUNK = 32 * 1024 * 1024
+    _TAIL_DEFAULT_BYTES = 4 * 1024 * 1024
+    _TAIL_MAX_BYTES = 16 * 1024 * 1024
+
+    @staticmethod
+    def _sync_source(peer: _Peer):
+        """The peer's DurableEngine, or None when the peer cannot serve
+        state sync (keyless/undurable peers have no WAL watermark to tail
+        from — a snapshot without one could never be caught up past)."""
+        engine = peer.engine
+        if hasattr(engine, "capture_consistent") and hasattr(engine, "wal"):
+            return engine
+        return None
+
+    def _op_sync_manifest(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        """Serve (building if stale) the snapshot manifest for a durable
+        peer. The snapshot file lives under the peer's WAL directory
+        (``<wal>/sync/snapshot.bin``) and is rebuilt only when the peer's
+        WAL position moved since the cached build — repeated manifest
+        requests against a quiet peer are free."""
+        from ..sync.snapshot import build_snapshot
+
+        max_chunk = c.u32()
+        engine = self._sync_source(peer)
+        if engine is None:
+            return P.STATUS_BAD_REQUEST, P.string(
+                "peer is not durable (no WAL): state sync needs a "
+                "watermark to tail from"
+            )
+        chunk_bytes = self._SYNC_MAX_CHUNK if max_chunk == 0 else max_chunk
+        chunk_bytes = min(chunk_bytes, self._SYNC_MAX_CHUNK)
+        with self._sync_lock:
+            gate = self._sync_gates.setdefault(peer.peer_id, threading.Lock())
+        with gate:  # serializes builds for THIS peer only
+            with self._sync_lock:
+                cached = self._sync_cache.get(peer.peer_id)
+            current = engine.wal.last_lsn
+            if (
+                cached is not None
+                and cached[0].watermark == current
+                and cached[0].chunk_bytes == chunk_bytes
+            ):
+                manifest, _path = cached
+            else:
+                with self._sync_lock:
+                    self._sync_seq += 1
+                    snapshot_id = self._sync_seq
+                path = os.path.join(
+                    engine.wal.directory, "sync", f"snapshot-{snapshot_id}.bin"
+                )
+                manifest = build_snapshot(
+                    engine, path,
+                    chunk_bytes=chunk_bytes, snapshot_id=snapshot_id,
+                )
+                with self._sync_lock:
+                    self._sync_cache[peer.peer_id] = (manifest, path)
+                # The superseded artifact is dead: chunk requests against
+                # its id already resolve to STATUS_SYNC_STALE (the cache
+                # holds only the new id), so the file can go.
+                if cached is not None:
+                    try:
+                        os.remove(cached[1])
+                    except OSError:
+                        pass
+                flight_recorder.record(
+                    "sync.snapshot_built",
+                    peer=peer.peer_id,
+                    snapshot_id=manifest.snapshot_id,
+                    watermark=manifest.watermark,
+                    bytes=manifest.total_bytes,
+                    sessions=manifest.session_count,
+                )
+        return P.STATUS_OK, (
+            P.u64(manifest.snapshot_id)
+            + P.u64(manifest.watermark)
+            + P.u64(manifest.total_bytes)
+            + P.u32(manifest.chunk_bytes)
+            + P.u32(manifest.session_count)
+            + P.u32(manifest.config_count)
+            + P.u32(manifest.chunk_count)
+            + b"".join(manifest.digests)
+        )
+
+    def _op_sync_chunk(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        snapshot_id = c.u64()
+        index = c.u32()
+        with self._sync_lock:
+            cached = self._sync_cache.get(peer.peer_id)
+        if cached is None or cached[0].snapshot_id != snapshot_id:
+            return P.STATUS_SYNC_STALE, P.string(
+                f"snapshot {snapshot_id} is no longer served; re-fetch "
+                "the manifest"
+            )
+        manifest, path = cached
+        if index >= manifest.chunk_count:
+            return P.STATUS_BAD_REQUEST, P.string(
+                f"chunk {index} out of range (snapshot has "
+                f"{manifest.chunk_count})"
+            )
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(index * manifest.chunk_bytes)
+                data = fh.read(manifest.chunk_bytes)
+        except OSError:
+            # Lost the race with a rebuild that removed this artifact
+            # between the cache read and the open: same signal as an id
+            # mismatch — refresh and resume.
+            return P.STATUS_SYNC_STALE, P.string(
+                f"snapshot {snapshot_id} was rebuilt; re-fetch the manifest"
+            )
+        self._m_sync_chunks.inc()
+        return P.STATUS_OK, P.blob(data)
+
+    def _op_wal_tail(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        from ..wal.recovery import read_tail
+
+        after_lsn = c.u64()
+        max_bytes = c.u32()
+        engine = self._sync_source(peer)
+        if engine is None:
+            return P.STATUS_BAD_REQUEST, P.string(
+                "peer is not durable (no WAL): nothing to tail"
+            )
+        budget = self._TAIL_DEFAULT_BYTES if max_bytes == 0 else max_bytes
+        budget = min(budget, self._TAIL_MAX_BYTES)
+        records, more = read_tail(engine.wal.directory, after_lsn, budget)
+        out = [P.u32(len(records))]
+        for lsn, kind, payload in records:
+            out.append(P.u64(lsn) + P.u8(kind) + P.blob(payload))
+        out.append(P.u8(1 if more else 0))
+        return P.STATUS_OK, b"".join(out)
+
     def _op_explain(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
         """Decision provenance as one JSON blob (see
         ``TpuConsensusEngine.explain_decision``); durable peers overlay
@@ -729,4 +913,7 @@ _HANDLERS = {
     P.OP_GET_STATS: BridgeServer._op_get_stats,
     P.OP_EXPLAIN: BridgeServer._op_explain,
     P.OP_HEALTH: BridgeServer._op_health,
+    P.OP_SYNC_MANIFEST: BridgeServer._op_sync_manifest,
+    P.OP_SYNC_CHUNK: BridgeServer._op_sync_chunk,
+    P.OP_WAL_TAIL: BridgeServer._op_wal_tail,
 }

@@ -57,6 +57,10 @@ hashgraph_tracked_peers / _evidence_records     gauge      default health monito
 hashgraph_stale_peers                           gauge      liveness watchdog
 hashgraph_jax_live_buffer_bytes                 gauge      live JAX array bytes (scrape-time)
 hashgraph_jax_compile_cache_{hits,misses}_total counter    persistent XLA compile cache
+hashgraph_sync_chunks_sent_total                counter    bridge sync source (snapshot chunks served)
+hashgraph_sync_chunks_received_total            counter    CatchUpClient (snapshot chunks verified)
+hashgraph_sync_tail_records_total               counter    CatchUpClient (WAL tail records applied)
+hashgraph_sync_catchup_seconds                  histogram  CatchUpClient (end-to-end catch-up)
 ==============================================  =========  ==================
 """
 
@@ -158,6 +162,14 @@ FLEET_SHARDS_RECOVERING = "hashgraph_fleet_shards_recovering"
 FLEET_ROUTED_VOTES_TOTAL = "hashgraph_fleet_routed_votes_total"
 FLEET_SWEEP_SECONDS = "hashgraph_fleet_sweep_seconds"
 
+# State sync (sync.client / bridge sync opcodes): snapshot chunks served
+# by the source, chunks received + WAL tail records applied by the
+# joiner, and the end-to-end catch-up wall time.
+SYNC_CHUNKS_SENT_TOTAL = "hashgraph_sync_chunks_sent_total"
+SYNC_CHUNKS_RECEIVED_TOTAL = "hashgraph_sync_chunks_received_total"
+SYNC_TAIL_RECORDS_TOTAL = "hashgraph_sync_tail_records_total"
+SYNC_CATCHUP_SECONDS = "hashgraph_sync_catchup_seconds"
+
 # Process-wide default registry (mirrors tracing.tracer's role).
 registry = MetricsRegistry()
 
@@ -173,6 +185,7 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         WAL_FSYNC_SECONDS,
         WAL_RECOVER_SECONDS,
         FLEET_SWEEP_SECONDS,
+        SYNC_CATCHUP_SECONDS,
     ):
         reg.histogram(name, DEFAULT_TIME_BUCKETS)
     reg.histogram(INGEST_BATCH_SIZE, DEFAULT_SIZE_BUCKETS)
@@ -214,6 +227,9 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         JAX_COMPILE_CACHE_HITS_TOTAL,
         JAX_COMPILE_CACHE_MISSES_TOTAL,
         FLEET_ROUTED_VOTES_TOTAL,
+        SYNC_CHUNKS_SENT_TOTAL,
+        SYNC_CHUNKS_RECEIVED_TOTAL,
+        SYNC_TAIL_RECORDS_TOTAL,
     ):
         reg.counter(name)
     reg.info(BUILD_INFO).set(

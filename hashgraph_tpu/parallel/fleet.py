@@ -214,6 +214,13 @@ class FleetShard:
         self.recovering = False
         self.recovery_error: "BaseException | None" = None
         self.votes_routed = 0  # rows this shard was handed by the router
+        # Last WAL replay's ReplayStats (recover_shard) — surfaced in
+        # occupancy()/health_report() so a fleet operator sees mid-log
+        # corruption (torn bytes, dropped segments, decode errors)
+        # without ssh'ing into the shard.
+        self.recovery_stats = None
+        # Last peer catch-up's CatchUpReport (catch_up_shard).
+        self.catchup_report = None
 
     @property
     def available(self) -> bool:
@@ -861,9 +868,40 @@ class ConsensusFleet:
         agg = np.asarray(reduce_fn(global_counts)).reshape(len(codes))
         return {code: int(c) for code, c in zip(codes, agg)}
 
+    @staticmethod
+    def _recovery_overlay(shard: FleetShard) -> dict:
+        """Durability-provenance block for one shard's readouts: how its
+        state was (re)built. ``wal_recover`` carries the last local
+        replay's corruption counters (nonzero torn_bytes past the tail /
+        dropped_segments / decode_errors = acknowledged records replay
+        could not reproduce — the operator-visible mid-log-corruption
+        signal); ``catch_up`` summarizes the last peer catch-up."""
+        out: dict = {}
+        stats = shard.recovery_stats
+        if stats is not None:
+            out["wal_recover"] = {
+                "records_applied": stats.records_applied,
+                "votes_replayed": stats.votes_replayed,
+                "torn_bytes": stats.torn_bytes,
+                "dropped_segments": stats.segments_dropped,
+                "decode_errors": len(stats.errors),
+            }
+        report = shard.catchup_report
+        if report is not None:
+            out["catch_up"] = {
+                "watermark": report.watermark,
+                "sessions_installed": report.sessions_installed,
+                "votes_verified": report.votes_verified,
+                "tail_records": report.tail_records,
+                "trust_snapshot": report.trust_snapshot,
+                "seconds": report.seconds,
+            }
+        return out
+
     def occupancy(self) -> dict:
         """Per-shard breakdown: engine occupancy + per-device slot
-        occupancy (the MULTICHIP artifact's per-device view)."""
+        occupancy (the MULTICHIP artifact's per-device view), plus the
+        shard's recovery provenance (see :meth:`_recovery_overlay`)."""
         out = {}
         for sid, shard in self._shards.items():
             if not shard.available:
@@ -882,17 +920,19 @@ class ConsensusFleet:
             entry["per_device_slots_used"] = (
                 shard.pool().per_device_occupancy()
             )
+            entry.update(self._recovery_overlay(shard))
             out[sid] = entry
         return out
 
     def health_report(self, now=None) -> dict:
         """Per-shard health (each shard carries a private monitor, so one
-        noisy shard's evidence never pollutes another's scorecards)."""
-        return {
-            sid: (
-                shard.health_report(now)
-                if shard.available
-                else {
+        noisy shard's evidence never pollutes another's scorecards); each
+        serving shard's report also carries its recovery provenance
+        (``wal_recover`` corruption counters / ``catch_up`` summary)."""
+        out = {}
+        for sid, shard in self._shards.items():
+            if not shard.available:
+                out[sid] = {
                     "recovering": True,
                     "recovery_error": (
                         repr(shard.recovery_error)
@@ -900,9 +940,11 @@ class ConsensusFleet:
                         else None
                     ),
                 }
-            )
-            for sid, shard in self._shards.items()
-        }
+                continue
+            report = dict(shard.health_report(now))
+            report.update(self._recovery_overlay(shard))
+            out[sid] = report
+        return out
 
     # ── Crash / recovery ───────────────────────────────────────────────
 
@@ -962,7 +1004,7 @@ class ConsensusFleet:
                         shard_id, shard.device, shard.index
                     )
                     try:
-                        fresh.engine.recover(on_record=on_record)
+                        stats = fresh.engine.recover(on_record=on_record)
                     except BaseException:
                         _close_engine(fresh.engine)  # release the dir
                         raise                        # flock for a retry
@@ -971,6 +1013,7 @@ class ConsensusFleet:
                     raise
                 shard.engine = fresh.engine
                 shard.wal_dir = fresh.wal_dir
+                shard.recovery_stats = stats
                 shard.recovering = False
 
         if background:
@@ -989,4 +1032,94 @@ class ConsensusFleet:
             thread.start()
             return thread
         _recover()
+        return None
+
+    def catch_up_shard(
+        self,
+        shard_id: str,
+        host: str,
+        port: int,
+        source_peer: int,
+        *,
+        trust_snapshot: bool = False,
+        background: bool = False,
+        wipe_local_wal: bool = True,
+    ):
+        """Rebuild a shard FROM A PEER instead of its local WAL — the
+        recovery path for a shard whose log is gone, corrupted, or too
+        far behind to matter: a fresh engine on the shard's device
+        catches up via :class:`~hashgraph_tpu.sync.CatchUpClient`
+        (snapshot install with one batched verify pass, then WAL-tail
+        the suffix) from ``source_peer`` on the bridge at
+        ``(host, port)``, then swaps in and resumes routing. Like
+        :meth:`recover_shard`, only THIS shard's traffic waits.
+
+        ``wipe_local_wal`` (default) clears the shard's local WAL
+        directory first: catch-up REPLACES local history, and appending
+        post-catch-up traffic after stale pre-crash records would leave
+        a log no future replay could interpret. The shard's new local
+        WAL then covers only post-catch-up traffic — checkpoint the
+        shard once it serves if it must survive its own crash without
+        re-syncing (the snapshot install itself is not logged, by the
+        ``DurableEngine.load_from_storage`` contract).
+
+        ``trust_snapshot`` skips the snapshot's signature verification
+        (operator-trusted sources only). ``background`` mirrors
+        :meth:`recover_shard`: failures land on ``shard.recovery_error``
+        and the shard stays unavailable for a retry. The installed
+        state's provenance is surfaced as ``catch_up`` in
+        :meth:`occupancy` / :meth:`health_report`.
+        """
+        import shutil
+
+        from ..sync import CatchUpClient
+
+        shard = self._shards[shard_id]
+        with shard.lock:
+            shard.recovering = True
+            if shard.engine is not None:
+                _close_engine(shard.engine)  # release the WAL flock
+                shard.engine = None
+
+        def _catch_up():
+            with shard.lock:
+                shard.recovery_error = None
+                try:
+                    if wipe_local_wal and shard.wal_dir is not None:
+                        shutil.rmtree(shard.wal_dir, ignore_errors=True)
+                    fresh = self._build_shard(
+                        shard_id, shard.device, shard.index
+                    )
+                    try:
+                        with CatchUpClient(host, port, source_peer) as client:
+                            report = client.catch_up(
+                                fresh.engine, trust_snapshot=trust_snapshot
+                            )
+                    except BaseException:
+                        _close_engine(fresh.engine)  # release the dir
+                        raise                        # flock for a retry
+                except BaseException as exc:
+                    shard.recovery_error = exc
+                    raise
+                shard.engine = fresh.engine
+                shard.wal_dir = fresh.wal_dir
+                shard.catchup_report = report
+                shard.recovery_stats = None  # state is the peer's, not the log's
+                shard.recovering = False
+
+        if background:
+            def _catch_up_guarded():
+                try:
+                    _catch_up()
+                except BaseException:
+                    pass  # recorded on shard.recovery_error, by design
+
+            thread = threading.Thread(
+                target=_catch_up_guarded,
+                name=f"catchup-{shard_id}",
+                daemon=True,
+            )
+            thread.start()
+            return thread
+        _catch_up()
         return None

@@ -1,0 +1,56 @@
+"""hashgraph_tpu.sync — state sync: snapshot shipping + WAL tailing.
+
+Turns cold-peer catch-up from O(full history × crypto) into O(suffix):
+the source serves a consistent snapshot of its engine state at a WAL LSN
+watermark (:mod:`.snapshot`, built under the DurableEngine's mutator
+lock, chunked and digest-pinned for transfer), and the joiner
+(:class:`.client.CatchUpClient`) verifies the snapshot's signed vote
+chains in one batched pass through the native verify pool, installs it
+atomically, then tails and applies only the WAL records past the
+watermark through the engine's live entry points — ``deliver`` records
+ride the validated-chain watermark, so even the tail's redeliveries
+verify only their suffixes.
+
+This is an embedder-layer construct over the reference's storage
+contract (src/storage.rs save/load semantics), not a protocol
+divergence: the snapshot carries exactly the canonical session/vote wire
+bytes the reference persists, plus the scalar lifecycle fields its
+storage trait round-trips. See PARITY.md.
+"""
+
+from .client import CatchUpClient, CatchUpReport, CatchUpState, verify_sessions
+from .errors import (
+    SnapshotDecodeError,
+    SnapshotDigestError,
+    SyncError,
+    SyncStateError,
+    SyncVerificationError,
+    TailGapError,
+    TailRecordError,
+)
+from .snapshot import (
+    DEFAULT_CHUNK_BYTES,
+    SnapshotManifest,
+    build_snapshot,
+    decode_snapshot,
+    state_fingerprint,
+)
+
+__all__ = [
+    "CatchUpClient",
+    "CatchUpReport",
+    "CatchUpState",
+    "DEFAULT_CHUNK_BYTES",
+    "SnapshotDecodeError",
+    "SnapshotDigestError",
+    "SnapshotManifest",
+    "SyncError",
+    "SyncStateError",
+    "SyncVerificationError",
+    "TailGapError",
+    "TailRecordError",
+    "build_snapshot",
+    "decode_snapshot",
+    "state_fingerprint",
+    "verify_sessions",
+]

@@ -197,6 +197,18 @@ class DurableEngine:
         out["wal"] = self._wal_overlay()
         return out
 
+    def capture_consistent(self, capture):
+        """Run ``capture(engine, watermark)`` under the mutator lock and
+        return its result: the callback observes a frozen engine whose
+        state reflects exactly the records with ``lsn <= watermark``
+        (mutators and the capture serialize on the same lock, so nothing
+        can land between reading the LSN and reading the state). This is
+        the consistency primitive state-sync snapshot builds ride on
+        (:func:`hashgraph_tpu.sync.snapshot.build_snapshot`); the capture
+        should be read-only and brief — writes stall for its duration."""
+        with self._lock:
+            return capture(self._engine, self._wal.last_lsn)
+
     def health_report(self, now=None) -> dict:
         """Engine health snapshot (scorecards / evidence / watchdog /
         alerts) plus this peer's durability position — same overlay as
@@ -643,6 +655,29 @@ class DurableEngine:
         if compact:
             self._wal.compact(watermark)
         return count
+
+    def compact(self) -> int:
+        """Second phase of the two-phase checkpoint for BUFFERING storage
+        backends: drop every sealed segment the most recent checkpoint
+        covers. The documented safe flow is ``checkpoint(storage,
+        compact=False)`` → make the snapshot durable → ``compact()``; this
+        method is that last step as one safe call (it compacts to
+        :attr:`last_checkpoint_watermark`, never beyond what a snapshot in
+        this process actually covered). Raises if no checkpoint ran yet —
+        compacting without one would delete the only copy of acknowledged
+        records. Returns the number of segments removed. A crash in the
+        window between the phases is safe in both orders: snapshot durable
+        but not compacted merely re-replays covered records (duplicate
+        rejection converges), and the un-compacted log still covers a
+        snapshot that never became durable."""
+        with self._lock:
+            if self._ckpt_watermark <= 0:
+                raise ValueError(
+                    "no checkpoint in this process: call "
+                    "checkpoint(storage, compact=False) first, make the "
+                    "snapshot durable, then compact()"
+                )
+            return self._wal.compact(self._ckpt_watermark)
 
     def load_from_storage(self, storage) -> int:
         """Delegates without logging: a bulk restore is snapshot-shaped

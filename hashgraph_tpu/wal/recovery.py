@@ -210,6 +210,25 @@ def _replay_record(engine, lsn, kind, payload, after_lsn, stats, tr) -> None:
     if lsn <= after_lsn:
         stats.records_skipped += 1
         return
+    apply_record(engine, kind, payload, stats, tracer=tr, lsn=lsn)
+
+
+def apply_record(
+    engine, kind: int, payload: bytes, stats: "ReplayStats | None" = None,
+    *, tracer=None, lsn: int = 0,
+) -> ReplayStats:
+    """Dispatch ONE decoded WAL record through the engine's live batch
+    entry points — the unit step of :func:`replay`, public so other
+    consumers of the record stream (the state-sync tail,
+    :mod:`hashgraph_tpu.sync.client`) apply records with identical
+    semantics: validation runs exactly as live traffic, rejections settle
+    as converged state, payload decode faults land in ``stats.errors``.
+    Snapshot marks are bookkeeping and apply nothing."""
+    if stats is None:
+        stats = ReplayStats()
+    tr = tracer if tracer is not None else default_tracer
+    if kind == F.KIND_SNAPSHOT:
+        return stats
     try:
         _apply(engine, kind, payload, stats)
     except ConsensusError:
@@ -220,9 +239,49 @@ def _replay_record(engine, lsn, kind, payload, after_lsn, stats, tr) -> None:
         # Payload decode fault inside a CRC-valid record: surface it,
         # keep replaying (the frame layer guarantees record boundaries).
         stats.errors.append((lsn, repr(exc)))
-        return
+        return stats
     stats.records_applied += 1
     tr.count("wal.recover.records")
+    return stats
+
+
+def read_tail(
+    directory: str,
+    after_lsn: int = 0,
+    max_bytes: int = 4 * 1024 * 1024,
+) -> "tuple[list[tuple[int, int, bytes]], bool]":
+    """Read intact records with ``lsn > after_lsn`` in log order, bounded
+    by ``max_bytes`` of payload — the serving side of WAL tailing
+    (``OP_WAL_TAIL``). Returns ``(records, more)``: ``more`` is True when
+    the budget stopped the read with further intact records available, so
+    a caller loops with ``after_lsn`` advanced to the last served LSN
+    until ``(few records, False)``.
+
+    Sealed segments entirely below ``after_lsn`` are skipped by filename
+    (their base LSNs bound their contents), so repeated tail polls on a
+    long log do not rescan history. The torn-tail rule applies: records
+    past the first bad frame are not served (a concurrent writer's
+    in-flight append parses as a torn tail and is simply served on the
+    next poll). LSN continuity of the result is the CLIENT's check —
+    a gap here means compaction or mid-log corruption ate part of the
+    suffix, and applying around it would reorder history."""
+    records: list[tuple[int, int, bytes]] = []
+    used = 0
+    segments = list_segments(directory)
+    for i, (base, path) in enumerate(segments):
+        if i + 1 < len(segments) and segments[i + 1][0] - 1 <= after_lsn:
+            continue  # sealed segment fully at or below the watermark
+        seg_records, valid_end, size = scan_segment(path)
+        for lsn, kind, payload in seg_records:
+            if lsn <= after_lsn:
+                continue
+            if records and used + len(payload) > max_bytes:
+                return records, True
+            records.append((lsn, kind, payload))
+            used += len(payload)
+        if valid_end < size:
+            break  # torn: later segments are unreachable-after-corruption
+    return records, False
 
 
 def _apply(engine, kind: int, payload: bytes, stats: ReplayStats) -> None:
