@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-catchup fleet-smoke catchup-smoke metrics-smoke trace-smoke smoke
+.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-catchup bench-gossip fleet-smoke catchup-smoke gossip-smoke metrics-smoke trace-smoke smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -55,6 +55,19 @@ bench-catchup:
 # byte-identical to the source, interrupted-transfer resume included.
 catchup-smoke:
 	JAX_PLATFORMS=cpu python examples/catchup_smoke.py
+
+# Networked gossip bench: N peers as separate OS processes over real TCP,
+# aggregate networked votes/sec, paired same-window A/B against the
+# serial BridgeClient loop with a machine-readable noise_verdict, and
+# per-rep cross-peer state_fingerprint equality asserts.
+bench-gossip:
+	python bench.py gossip
+
+# CI short run: 3 in-process peers — pipelining + coalescing + a
+# sampled-fanout divergence healed by ONE anti-entropy round, final
+# state fingerprint-identical across peers.
+gossip-smoke:
+	JAX_PLATFORMS=cpu python bench.py gossip --smoke
 
 # End-to-end observability check: start a bridge server (WAL + HTTP
 # sidecar), drive a proposal to decision, scrape /metrics + /healthz and

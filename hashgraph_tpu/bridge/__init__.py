@@ -1,11 +1,26 @@
 """Embedder/FFI bridge: the consensus surface for non-Python processes.
 
 See :mod:`hashgraph_tpu.bridge.protocol` for the wire format,
-:class:`~hashgraph_tpu.bridge.server.BridgeServer` for the host side, and
-``native/bridge_client.c`` for the C reference embedder.
+:class:`~hashgraph_tpu.bridge.server.BridgeServer` for the host side,
+``native/bridge_client.c`` for the C reference embedder, and
+:class:`~hashgraph_tpu.bridge.client.PipelinedBridgeClient` for the
+feature-negotiated many-in-flight client the gossip fabric builds on.
 """
 
-from .client import BridgeClient, BridgeError, BridgeEvent
+from .client import (
+    BridgeClient,
+    BridgeConnectionLost,
+    BridgeError,
+    BridgeEvent,
+    PipelinedBridgeClient,
+)
 from .server import BridgeServer
 
-__all__ = ["BridgeClient", "BridgeError", "BridgeEvent", "BridgeServer"]
+__all__ = [
+    "BridgeClient",
+    "BridgeConnectionLost",
+    "BridgeError",
+    "BridgeEvent",
+    "BridgeServer",
+    "PipelinedBridgeClient",
+]

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import json
 import socket
+import threading
+from concurrent.futures import Future
 from dataclasses import dataclass
 
 from ..errors import StatusCode, error_for_code
@@ -26,6 +28,14 @@ class BridgeError(Exception):
         except ValueError:
             name = f"bridge status {status}"
         super().__init__(f"{name}: {message}" if message else name)
+
+
+class BridgeConnectionLost(ConnectionError):
+    """The bridge connection died with requests still in flight. Every
+    pending future of a :class:`PipelinedBridgeClient` (and of the gossip
+    transport's channels) resolves to this — a typed, per-request signal
+    that the response will never arrive, distinct from a server-side
+    rejection (:class:`BridgeError`)."""
 
 
 @dataclass(frozen=True)
@@ -51,7 +61,7 @@ class BridgeClient:
 
     def __init__(self, host: str, port: int, timeout: float = 10.0):
         self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        P.tune_socket(self._sock)  # TCP_NODELAY on: small-frame wire
         #: Trace context returned by the last create_proposal/cast_vote.
         self.last_trace_context: TraceContext | None = None
 
@@ -250,8 +260,22 @@ class BridgeClient:
             raise error_for_code(int(StatusCode.CONSENSUS_FAILED))()
         return value == P.RESULT_YES
 
-    def poll_events(self, peer: int) -> list[BridgeEvent]:
-        cursor = self._call(P.OP_POLL_EVENTS, P.u32(peer))
+    def poll_events(self, peer: int, max_events: int | None = None):
+        """Drain the peer's pending consensus events in ONE frame.
+
+        ``max_events=None`` (the old wire form) returns the full drained
+        ``list[BridgeEvent]``. With a bound — the gossip fabric's event
+        pump, which must not let one hot peer monopolize a poll window —
+        the request carries a trailing ``u32`` and the reply a trailing
+        ``more`` flag: returns ``(events, more)``, where ``more`` means
+        the bound stopped the drain and another poll should follow
+        immediately (requires a ``FEATURE_EVENT_BOUND`` server; old
+        servers ignore the extra bytes and drain fully, so the caller
+        sees ``more=False`` with a possibly over-bound list)."""
+        payload = P.u32(peer)
+        if max_events is not None:
+            payload += P.u32(max_events)
+        cursor = self._call(P.OP_POLL_EVENTS, payload)
         events = []
         for _ in range(cursor.u32()):
             scope = cursor.string()
@@ -260,7 +284,10 @@ class BridgeClient:
             result = bool(cursor.u8())
             ts = cursor.u64()
             events.append(BridgeEvent(scope, kind, pid, result, ts))
-        return events
+        if max_events is None:
+            return events
+        more = bool(cursor.u8()) if cursor.remaining() >= 1 else False
+        return events, more
 
     def get_proposal(self, peer: int, scope: str, pid: int) -> bytes:
         return self._call(
@@ -305,21 +332,9 @@ class BridgeClient:
         SHA-256 ``digests``. ``max_chunk_bytes`` caps the server's chunk
         size (0 = server default). Raises BridgeError(241) for
         undurable peers."""
-        cursor = self._call(
-            P.OP_SYNC_MANIFEST, P.u32(peer) + P.u32(max_chunk_bytes)
+        return parse_sync_manifest(
+            self._call(P.OP_SYNC_MANIFEST, P.u32(peer) + P.u32(max_chunk_bytes))
         )
-        manifest = {
-            "snapshot_id": cursor.u64(),
-            "watermark": cursor.u64(),
-            "total_bytes": cursor.u64(),
-            "chunk_bytes": cursor.u32(),
-            "session_count": cursor.u32(),
-            "config_count": cursor.u32(),
-        }
-        count = cursor.u32()
-        manifest["chunk_count"] = count
-        manifest["digests"] = [cursor.raw(32) for _ in range(count)]
-        return manifest
 
     def sync_chunk(self, peer: int, snapshot_id: int, index: int) -> bytes:
         """One snapshot chunk (``OP_SYNC_CHUNK``). Raises
@@ -354,3 +369,390 @@ class BridgeClient:
         sidecar's ``/metrics`` serves, for embedders that only hold the
         bridge wire."""
         return self._call(P.OP_GET_METRICS).blob().decode("utf-8")
+
+    def state_fingerprint(self, peer: int) -> str:
+        """The peer engine's order-insensitive content digest
+        (``OP_STATE_FINGERPRINT``; see ``sync.state_fingerprint``) — two
+        peers are state-identical iff their fingerprints match."""
+        return self._call(P.OP_STATE_FINGERPRINT, P.u32(peer)).string()
+
+    def hello(self, features: int | None = None) -> int:
+        """Feature negotiation (``OP_HELLO``); returns the granted bits.
+        The default offer deliberately EXCLUDES ``FEATURE_PIPELINING``:
+        this client reads one response per request, and a granted
+        pipelining bit switches the connection to tagged frames it does
+        not speak — use :class:`PipelinedBridgeClient` for that. An old
+        server answers UNKNOWN_OPCODE, reported here as 0 (no features),
+        after which this connection continues exactly as before."""
+        if features is None:
+            features = P.SUPPORTED_FEATURES & ~P.FEATURE_PIPELINING
+        if features & P.FEATURE_PIPELINING:
+            raise ValueError(
+                "BridgeClient cannot negotiate FEATURE_PIPELINING "
+                "(tagged frames); use PipelinedBridgeClient"
+            )
+        try:
+            cursor = self._call(
+                P.OP_HELLO, P.u32(P.PROTOCOL_VERSION) + P.u32(features)
+            )
+        except BridgeError as exc:
+            if exc.status == P.STATUS_UNKNOWN_OPCODE:
+                return 0
+            raise
+        cursor.u32()  # server protocol version (1)
+        return cursor.u32()
+
+    def deliver_proposals(
+        self, peer: int, items: "list[tuple[str, bytes]]", now: int
+    ) -> list[int]:
+        """Anti-entropy delivery (``OP_DELIVER_PROPOSALS``): create-or-
+        extend each ``(scope, proposal wire bytes)`` along the engine's
+        validated-chain watermark. Returns per-item StatusCode values
+        (0 OK = created or suffix-extended; 21 PROPOSAL_ALREADY_EXIST =
+        benign redelivery; 241 = undecodable blob). Requires a
+        ``FEATURE_DELIVER`` server."""
+        cursor = self._call(
+            P.OP_DELIVER_PROPOSALS,
+            P.encode_deliver_proposals(peer, items, now),
+        )
+        return list(cursor.raw(cursor.u32()))
+
+
+# ── Shared response parsers (serial client, pipelined client, gossip
+#    transport — one home for each payload's field walk) ───────────────
+
+
+def parse_sync_manifest(cursor: P.Cursor) -> dict:
+    """Field walk of an ``OP_SYNC_MANIFEST`` OK response."""
+    manifest = {
+        "snapshot_id": cursor.u64(),
+        "watermark": cursor.u64(),
+        "total_bytes": cursor.u64(),
+        "chunk_bytes": cursor.u32(),
+        "session_count": cursor.u32(),
+        "config_count": cursor.u32(),
+    }
+    count = cursor.u32()
+    manifest["chunk_count"] = count
+    manifest["digests"] = [cursor.raw(32) for _ in range(count)]
+    return manifest
+
+
+def parse_status_list(cursor: P.Cursor) -> list[int]:
+    """``u32 count + count status bytes`` (PROCESS_VOTES / VOTE_BATCH /
+    DELIVER_PROPOSALS responses)."""
+    return list(cursor.raw(cursor.u32()))
+
+
+class MappedFuture:
+    """A :class:`concurrent.futures.Future` view whose ``result()``
+    applies a parse function to the resolved cursor. The underlying
+    future resolves to the response payload cursor (or raises
+    :class:`BridgeError` / :class:`BridgeConnectionLost`)."""
+
+    __slots__ = ("_future", "_fn")
+
+    def __init__(self, future: Future, fn):
+        self._future = future
+        self._fn = fn
+
+    def result(self, timeout: float | None = None):
+        return self._fn(self._future.result(timeout))
+
+    def exception(self, timeout: float | None = None):
+        return self._future.exception(timeout)
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def add_done_callback(self, fn) -> None:
+        self._future.add_done_callback(lambda _f: fn(self))
+
+
+class PipelinedBridgeClient:
+    """A bridge connection with many requests in flight.
+
+    On connect it sends ``OP_HELLO``; a new server grants
+    ``FEATURE_PIPELINING`` and the connection switches to tagged frames —
+    :meth:`submit` then returns immediately with a future, a background
+    reader matches responses to futures by correlation id (responses may
+    complete out of order), and ``max_inflight`` bounds the outstanding
+    window (submit blocks — natural backpressure — when the server falls
+    behind). Against an OLD server (HELLO answered UNKNOWN_OPCODE) every
+    call degrades to the serial one-frame-at-a-time exchange and
+    :meth:`submit` returns an already-resolved future, so callers write
+    one code path and interoperate both ways; :attr:`pipelined` says
+    which mode the connection landed in.
+
+    If the connection drops with requests in flight, every pending
+    future raises :class:`BridgeConnectionLost`.
+
+    Not thread-safe for concurrent submitters by design EXCEPT
+    :meth:`submit`/the async helpers, which take the writer lock; the
+    sync convenience wrappers just await their own future.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 10.0,
+        *,
+        max_inflight: int = 256,
+        features: int = P.SUPPORTED_FEATURES,
+    ):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        P.tune_socket(self._sock)
+        self._timeout = timeout
+        self._closed = False
+        self._features = 0
+        # HELLO handshake runs in the plain one-frame framing; only a
+        # granted pipelining bit switches the connection.
+        self._sock.sendall(
+            P.encode_frame(
+                P.OP_HELLO, P.u32(P.PROTOCOL_VERSION) + P.u32(features)
+            )
+        )
+        status, cursor = P.read_frame(self._sock)
+        if status == P.STATUS_OK:
+            cursor.u32()  # server protocol version
+            self._features = cursor.u32()
+        elif status != P.STATUS_UNKNOWN_OPCODE:
+            message = ""
+            try:
+                message = cursor.string()
+            except ValueError:
+                pass
+            self._sock.close()
+            raise BridgeError(status, message)
+        self.pipelined = bool(self._features & P.FEATURE_PIPELINING)
+        self._write_lock = threading.Lock()
+        self._pending: dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+        self._next_corr = 0
+        self._window = threading.BoundedSemaphore(max_inflight)
+        self._reader: threading.Thread | None = None
+        if self.pipelined:
+            # The reader blocks in recv for the connection's lifetime;
+            # close() unblocks it by shutting the socket down.
+            self._sock.settimeout(None)
+            self._reader = threading.Thread(
+                target=self._read_loop, daemon=True,
+                name="bridge-pipelined-reader",
+            )
+            self._reader.start()
+
+    @property
+    def features(self) -> int:
+        """Feature bits the server granted (0 against an old server)."""
+        return self._features
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+        if self._reader is not None:
+            self._reader.join(timeout=5)
+
+    def __enter__(self) -> "PipelinedBridgeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ── plumbing ───────────────────────────────────────────────────────
+
+    def submit(self, opcode: int, payload: bytes = b"") -> Future:
+        """Send one request; the future resolves to the response payload
+        cursor on STATUS_OK, or raises :class:`BridgeError` (non-OK) /
+        :class:`BridgeConnectionLost` (connection died first). In serial
+        fallback mode the exchange happens inline and the returned
+        future is already resolved."""
+        future: Future = Future()
+        if not self.pipelined:
+            try:
+                with self._write_lock:
+                    self._sock.sendall(P.encode_frame(opcode, payload))
+                    status, cursor = P.read_frame(self._sock)
+            except (ConnectionError, OSError) as exc:
+                future.set_exception(
+                    BridgeConnectionLost(f"bridge connection lost: {exc}")
+                )
+                return future
+            if status == P.STATUS_OK:
+                future.set_result(cursor)
+            else:
+                future.set_exception(BridgeError(status, _error_message(cursor)))
+            return future
+        # Window credit: bounds client-side memory AND stops a runaway
+        # submitter from ballooning the server's per-connection queue.
+        self._window.acquire()
+        with self._pending_lock:
+            if self._closed:
+                self._window.release()
+                future.set_exception(
+                    BridgeConnectionLost("client closed with request unsent")
+                )
+                return future
+            corr = self._next_corr
+            self._next_corr = (corr + 1) & 0xFFFFFFFF
+            self._pending[corr] = future
+        try:
+            with self._write_lock:
+                self._sock.sendall(P.encode_tagged_frame(opcode, corr, payload))
+        except (ConnectionError, OSError) as exc:
+            # The reader may have noticed the death first and already
+            # failed (and released the window for) every pending future,
+            # this one included — only the side that POPS the entry owns
+            # its release + exception, so neither is ever doubled.
+            with self._pending_lock:
+                owned = self._pending.pop(corr, None) is not None
+            if owned:
+                self._window.release()
+                future.set_exception(
+                    BridgeConnectionLost(f"bridge connection lost: {exc}")
+                )
+        return future
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                status, corr, cursor = P.read_tagged_frame(self._sock)
+                with self._pending_lock:
+                    future = self._pending.pop(corr, None)
+                if future is None:
+                    continue  # cancelled/unknown id: drop, keep reading
+                self._window.release()
+                if status == P.STATUS_OK:
+                    future.set_result(cursor)
+                else:
+                    future.set_exception(
+                        BridgeError(status, _error_message(cursor))
+                    )
+        except (ConnectionError, OSError, ValueError) as exc:
+            with self._pending_lock:
+                pending = list(self._pending.values())
+                self._pending.clear()
+                self._closed = True
+            lost = BridgeConnectionLost(
+                "bridge connection lost with "
+                f"{len(pending)} requests in flight: {exc}"
+            )
+            for future in pending:
+                self._window.release()
+                future.set_exception(lost)
+
+    def call(self, opcode: int, payload: bytes = b"") -> P.Cursor:
+        """Blocking :meth:`submit` (one round trip in either mode)."""
+        return self.submit(opcode, payload).result(self._timeout)
+
+    # ── async API (futures) ────────────────────────────────────────────
+
+    def ping_async(self) -> MappedFuture:
+        return MappedFuture(self.submit(P.OP_PING), lambda c: c.u32())
+
+    def process_votes_async(
+        self, peer: int, scope: str, votes: list[bytes], now: int
+    ) -> MappedFuture:
+        """One OP_PROCESS_VOTES frame in flight; resolves to the per-vote
+        status list (no transparent chunking — the coalescer owns frame
+        sizing on the fabric path)."""
+        payload = [P.u32(peer), P.string(scope), P.u64(now), P.u32(len(votes))]
+        payload.extend(P.blob(v) for v in votes)
+        return MappedFuture(
+            self.submit(P.OP_PROCESS_VOTES, b"".join(payload)),
+            parse_status_list,
+        )
+
+    def vote_batch_async(
+        self, now: int, groups: "list[tuple[int, str, list[bytes]]]"
+    ) -> MappedFuture:
+        """One coalesced columnar ``OP_VOTE_BATCH`` frame (requires
+        ``FEATURE_VOTE_BATCH``); resolves to the flattened status list."""
+        return MappedFuture(
+            self.submit(P.OP_VOTE_BATCH, P.encode_vote_batch(now, groups)),
+            parse_status_list,
+        )
+
+    def deliver_proposals_async(
+        self, peer: int, items: "list[tuple[str, bytes]]", now: int
+    ) -> MappedFuture:
+        return MappedFuture(
+            self.submit(
+                P.OP_DELIVER_PROPOSALS,
+                P.encode_deliver_proposals(peer, items, now),
+            ),
+            parse_status_list,
+        )
+
+    # ── sync conveniences (setup traffic; same wire as BridgeClient) ───
+
+    def ping(self) -> int:
+        return self.ping_async().result(self._timeout)
+
+    def add_peer(self, private_key: bytes | None = None) -> tuple[int, bytes]:
+        key = private_key or b""
+        cursor = self.call(P.OP_ADD_PEER, P.u8(len(key)) + key)
+        peer_id = cursor.u32()
+        return peer_id, cursor.raw(cursor.u8())
+
+    def create_proposal(
+        self,
+        peer: int,
+        scope: str,
+        now: int,
+        name: str,
+        payload: bytes,
+        expected_voters: int,
+        rel_expiration: int,
+        liveness_yes: bool = True,
+    ) -> tuple[int, bytes]:
+        cursor = self.call(
+            P.OP_CREATE_PROPOSAL,
+            P.u32(peer)
+            + P.string(scope)
+            + P.u64(now)
+            + P.string(name)
+            + P.blob(payload)
+            + P.u32(expected_voters)
+            + P.u64(rel_expiration)
+            + P.u8(1 if liveness_yes else 0),
+        )
+        return cursor.u32(), cursor.blob()
+
+    def process_proposal(
+        self, peer: int, scope: str, proposal: bytes, now: int
+    ) -> None:
+        self.call(
+            P.OP_PROCESS_PROPOSAL,
+            P.u32(peer) + P.string(scope) + P.u64(now) + P.blob(proposal),
+        )
+
+    def process_votes(
+        self, peer: int, scope: str, votes: list[bytes], now: int
+    ) -> list[int]:
+        return self.process_votes_async(peer, scope, votes, now).result(
+            self._timeout
+        )
+
+    def deliver_proposals(
+        self, peer: int, items: "list[tuple[str, bytes]]", now: int
+    ) -> list[int]:
+        return self.deliver_proposals_async(peer, items, now).result(
+            self._timeout
+        )
+
+    def sync_manifest(self, peer: int, max_chunk_bytes: int = 0) -> dict:
+        return parse_sync_manifest(
+            self.call(P.OP_SYNC_MANIFEST, P.u32(peer) + P.u32(max_chunk_bytes))
+        )
+
+
+def _error_message(cursor: P.Cursor) -> str:
+    try:
+        return cursor.string()
+    except ValueError:
+        return ""

@@ -39,10 +39,43 @@ Handlers never require the suffix (frames without it decode exactly as
 before) and never read past their declared fields, so old and new peers
 interoperate in both directions: an old server ignores the trailing
 bytes, an old client ignores the suffixed response tail.
+
+**Feature negotiation + pipelining (optional, backward compatible).**
+``OP_HELLO`` (``u32 protocol_version + u32 offered feature bits`` ->
+``u32 protocol_version + u32 granted feature bits``) lets a connection
+upgrade itself. A peer that never sends HELLO gets exactly the old wire;
+an old server answers HELLO with ``STATUS_UNKNOWN_OPCODE`` — the
+canonical "no features" reply, after which the connection continues in
+the old one-at-a-time framing. When ``FEATURE_PIPELINING`` is granted,
+every subsequent frame on that connection (both directions) switches to
+the *tagged* layout:
+
+    request:  u32 length | u8 opcode | u32 correlation_id | payload
+    response: u32 length | u8 status | u32 correlation_id | payload
+
+(``length`` counts the lead byte, the 4-byte correlation id, and the
+payload.) Many requests may be in flight; the server answers each with
+its request's correlation id, and responses MAY complete out of order —
+read-only opcodes dispatch concurrently, while state-mutating opcodes
+(create/cast/process/deliver/timeout) from one connection execute in
+receive order, so a pipelined vote stream keeps its chain order without
+waiting a round trip per frame. Correlation ids are opaque to the
+server; clients allocate them (wrapping u32 counters).
+
+``FEATURE_VOTE_BATCH`` grants ``OP_VOTE_BATCH`` — the coalesced columnar
+vote frame (see :func:`encode_vote_batch`) landing many small votes for
+many (peer, scope) targets in one frame and one pipelined engine
+dispatch per peer. ``FEATURE_DELIVER`` grants ``OP_DELIVER_PROPOSALS`` —
+gossip anti-entropy delivery riding the engine's validated-chain
+watermark (redelivered chains verify only their suffix).
+``FEATURE_EVENT_BOUND`` grants the bounded ``OP_POLL_EVENTS`` request
+form (trailing ``u32 max_events``; the response then carries a trailing
+``u8 more`` flag).
 """
 
 from __future__ import annotations
 
+import socket as _socket
 import struct
 
 from ..obs.trace import TRACE_WIRE_BYTES, TraceContext
@@ -97,6 +130,42 @@ OP_SYNC_CHUNK = 16
 # order, resumable by advancing after_lsn to the last received LSN;
 # ``more`` = 1 when the byte budget stopped the read short.
 OP_WAL_TAIL = 17
+# ── Gossip fabric (feature-negotiated; see the module docstring) ──────
+# HELLO: u32 protocol_version + u32 offered feature bits ->
+# u32 protocol_version + u32 granted bits (offered ∩ supported). No
+# peer_id prefix (like PING). Old servers answer STATUS_UNKNOWN_OPCODE,
+# which clients treat as "zero features granted".
+OP_HELLO = 18
+# VOTE_BATCH: the coalesced columnar vote frame (encode_vote_batch) —
+# many (peer_id, scope) groups of small vote payloads in ONE frame,
+# landed via ingest_votes_pipelined per peer. Response: u32 total |
+# one status byte per vote in flattened batch order. No peer_id prefix
+# (groups carry their own).
+OP_VOTE_BATCH = 19
+# DELIVER_PROPOSALS: u32 peer_id | u64 now | u32 count |
+# count × (string scope | blob proposal) -> u32 count | count status
+# bytes. Lands on TpuConsensusEngine.deliver_proposals: unknown
+# sessions are created, known ones EXTEND along the validated-chain
+# watermark (suffix-only crypto), redeliveries settle crypto-free —
+# the anti-entropy primitive.
+OP_DELIVER_PROPOSALS = 20
+
+# STATE_FINGERPRINT: u32 peer_id -> string (hex). The peer engine's
+# order-insensitive content digest (sync.state_fingerprint) — the
+# convergence check the gossip bench/smoke asserts across peers that
+# live in DIFFERENT processes (in-process tests can reach the engine;
+# networked peers cannot).
+OP_STATE_FINGERPRINT = 21
+
+# HELLO feature bits.
+FEATURE_PIPELINING = 1 << 0
+FEATURE_VOTE_BATCH = 1 << 1
+FEATURE_DELIVER = 1 << 2
+FEATURE_EVENT_BOUND = 1 << 3
+SUPPORTED_FEATURES = (
+    FEATURE_PIPELINING | FEATURE_VOTE_BATCH | FEATURE_DELIVER
+    | FEATURE_EVENT_BOUND
+)
 
 # Bridge-level statuses (protocol StatusCode values occupy 0..29).
 STATUS_OK = 0
@@ -118,13 +187,29 @@ EVENT_FAILED = 2
 
 MAX_FRAME = 64 * 1024 * 1024  # hard cap against garbage length prefixes
 
+# Precompiled header/field structs: encode_frame and the Cursor integer
+# reads are the per-frame hot path (the coalesced fabric moves hundreds
+# of thousands of frames and fields per second), and `struct.pack("<I",
+# v)` re-parses its format string and allocates an intermediate on every
+# call. One compiled Struct per width, reused for the process lifetime.
+_U8 = struct.Struct("<B")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_FRAME_HEADER = struct.Struct("<IB")  # length | lead
+_TAGGED_HEADER = struct.Struct("<IBI")  # length | lead | correlation id
+
 
 class Cursor:
-    """Sequential reader over one frame's payload."""
+    """Sequential reader over one frame's payload. ``start`` lets framed
+    readers hand the body over without slicing off the already-consumed
+    header bytes (one allocation saved per frame)."""
 
-    def __init__(self, data: bytes):
+    __slots__ = ("_data", "_pos")
+
+    def __init__(self, data: bytes, start: int = 0):
         self._data = data
-        self._pos = 0
+        self._pos = start
 
     def _take(self, n: int) -> bytes:
         if self._pos + n > len(self._data):
@@ -137,16 +222,32 @@ class Cursor:
         return self._take(n)
 
     def u8(self) -> int:
-        return self._take(1)[0]
+        pos = self._pos
+        if pos + 1 > len(self._data):
+            raise ValueError("frame truncated")
+        self._pos = pos + 1
+        return self._data[pos]
 
     def u16(self) -> int:
-        return struct.unpack("<H", self._take(2))[0]
+        pos = self._pos
+        if pos + 2 > len(self._data):
+            raise ValueError("frame truncated")
+        self._pos = pos + 2
+        return _U16.unpack_from(self._data, pos)[0]
 
     def u32(self) -> int:
-        return struct.unpack("<I", self._take(4))[0]
+        pos = self._pos
+        if pos + 4 > len(self._data):
+            raise ValueError("frame truncated")
+        self._pos = pos + 4
+        return _U32.unpack_from(self._data, pos)[0]
 
     def u64(self) -> int:
-        return struct.unpack("<Q", self._take(8))[0]
+        pos = self._pos
+        if pos + 8 > len(self._data):
+            raise ValueError("frame truncated")
+        self._pos = pos + 8
+        return _U64.unpack_from(self._data, pos)[0]
 
     def string(self) -> str:
         return self._take(self.u16()).decode("utf-8")
@@ -161,34 +262,33 @@ class Cursor:
         return len(self._data) - self._pos
 
 
-def u8(v: int) -> bytes:
-    return struct.pack("<B", v)
-
-
-def u16(v: int) -> bytes:
-    return struct.pack("<H", v)
-
-
-def u32(v: int) -> bytes:
-    return struct.pack("<I", v)
-
-
-def u64(v: int) -> bytes:
-    return struct.pack("<Q", v)
+# Field encoders: the compiled Structs' bound ``pack`` methods ARE the
+# functions (same signatures, same struct.error on out-of-range values,
+# no per-call format parse).
+u8 = _U8.pack
+u16 = _U16.pack
+u32 = _U32.pack
+u64 = _U64.pack
 
 
 def string(s: str) -> bytes:
     raw = s.encode("utf-8")
-    return u16(len(raw)) + raw
+    return _U16.pack(len(raw)) + raw
 
 
 def blob(b: bytes) -> bytes:
-    return u32(len(b)) + b
+    return _U32.pack(len(b)) + b
 
 
 def encode_frame(lead: int, payload: bytes = b"") -> bytes:
     """``lead`` is the opcode (requests) or status (responses)."""
-    return u32(1 + len(payload)) + u8(lead) + payload
+    return _FRAME_HEADER.pack(1 + len(payload), lead) + payload
+
+
+def encode_tagged_frame(lead: int, corr_id: int, payload: bytes = b"") -> bytes:
+    """Pipelined-mode frame: ``lead`` + correlation id + payload (only
+    valid on a connection that negotiated ``FEATURE_PIPELINING``)."""
+    return _TAGGED_HEADER.pack(5 + len(payload), lead, corr_id) + payload
 
 
 # ── Optional trace-context suffix ──────────────────────────────────────
@@ -223,21 +323,136 @@ def read_trace_context(c: Cursor) -> TraceContext | None:
 
 
 def read_exact(sock, n: int) -> bytes:
-    """Read exactly n bytes from a socket; raises ConnectionError on EOF."""
-    chunks = []
-    while n > 0:
-        chunk = sock.recv(n)
-        if not chunk:
+    """Read exactly n bytes from a socket; raises ConnectionError on EOF.
+    Reads into one preallocated buffer (``recv_into``) instead of
+    accumulating chunk objects and joining — one allocation per frame
+    body regardless of how the kernel segments it."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    pos = 0
+    while pos < n:
+        got = sock.recv_into(view[pos:])
+        if not got:
             raise ConnectionError("bridge peer closed the connection")
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+        pos += got
+    return bytes(buf)
 
 
 def read_frame(sock) -> tuple[int, Cursor]:
     """Returns (opcode-or-status, payload cursor)."""
-    (length,) = struct.unpack("<I", read_exact(sock, 4))
+    (length,) = _U32.unpack(read_exact(sock, 4))
     if length < 1 or length > MAX_FRAME:
         raise ValueError(f"bad frame length {length}")
     body = read_exact(sock, length)
-    return body[0], Cursor(body[1:])
+    return body[0], Cursor(body, 1)
+
+
+def read_tagged_frame(sock) -> tuple[int, int, Cursor]:
+    """Pipelined-mode :func:`read_frame`: returns (opcode-or-status,
+    correlation id, payload cursor)."""
+    (length,) = _U32.unpack(read_exact(sock, 4))
+    if length < 5 or length > MAX_FRAME:
+        raise ValueError(f"bad tagged frame length {length}")
+    body = read_exact(sock, length)
+    return body[0], _U32.unpack_from(body, 1)[0], Cursor(body, 5)
+
+
+def parse_frame(body: bytes, tagged: bool) -> tuple[int, int, Cursor]:
+    """Parse one already-read frame body (the length prefix stripped):
+    returns (lead, correlation id — 0 when untagged, payload cursor).
+    The non-blocking transport reads socket bytes into its own buffer
+    and hands complete bodies here."""
+    if tagged:
+        if len(body) < 5:
+            raise ValueError("tagged frame truncated")
+        return body[0], _U32.unpack_from(body, 1)[0], Cursor(body, 5)
+    if len(body) < 1:
+        raise ValueError("frame truncated")
+    return body[0], 0, Cursor(body, 1)
+
+
+# ── Coalesced columnar vote frames (OP_VOTE_BATCH) ─────────────────────
+#
+# Layout: u64 now | u32 group_count
+#         | group_count × (u32 peer_id | string scope | u32 vote_count)
+#         | Σvote_count × u32 vote_len        (columnar lengths)
+#         | concatenated vote payload bytes    (same flattened order)
+# Response: u32 total | total × u8 status (flattened batch order; the
+# per-vote codes mirror OP_PROCESS_VOTES: StatusCode values, 241 for an
+# undecodable blob, STATUS_UNKNOWN_PEER for a group naming no peer).
+
+
+def encode_vote_batch(
+    now: int, groups: "list[tuple[int, str, list[bytes]]]"
+) -> bytes:
+    """One coalesced frame payload from ``(peer_id, scope, votes)``
+    groups (votes as wire bytes). Order inside a group — and across
+    groups — is preserved end to end, so chained votes coalesced in
+    submission order land in submission order."""
+    head = [u64(now), u32(len(groups))]
+    lens: list[bytes] = []
+    bodies: list[bytes] = []
+    for peer_id, scope, votes in groups:
+        head.append(u32(peer_id) + string(scope) + u32(len(votes)))
+        for v in votes:
+            lens.append(u32(len(v)))
+            bodies.append(v)
+    return b"".join(head) + b"".join(lens) + b"".join(bodies)
+
+
+def decode_vote_batch(
+    c: Cursor,
+) -> "tuple[int, list[tuple[int, str, list[bytes]]]]":
+    """Inverse of :func:`encode_vote_batch`: (now, groups)."""
+    now = c.u64()
+    metas: list[tuple[int, str, int]] = []
+    for _ in range(c.u32()):
+        peer_id = c.u32()
+        scope = c.string()
+        metas.append((peer_id, scope, c.u32()))
+    lens: list[int] = [c.u32() for _ in range(sum(m[2] for m in metas))]
+    groups: list[tuple[int, str, list[bytes]]] = []
+    k = 0
+    for peer_id, scope, count in metas:
+        votes = []
+        for _ in range(count):
+            votes.append(c.raw(lens[k]))
+            k += 1
+        groups.append((peer_id, scope, votes))
+    return now, groups
+
+
+def encode_deliver_proposals(
+    peer_id: int, items: "list[tuple[str, bytes]]", now: int
+) -> bytes:
+    """``OP_DELIVER_PROPOSALS`` request payload: one home for the field
+    walk (serial client, pipelined client, gossip node all send it)."""
+    out = [u32(peer_id), u64(now), u32(len(items))]
+    for scope, proposal in items:
+        out.append(string(scope))
+        out.append(blob(proposal))
+    return b"".join(out)
+
+
+# ── Socket tuning ──────────────────────────────────────────────────────
+
+
+def tune_socket(sock, *, nodelay: bool = True,
+                sndbuf: int | None = None, rcvbuf: int | None = None) -> None:
+    """Apply the bridge's socket defaults. ``TCP_NODELAY`` is ON for
+    every bridge socket (both ends): the wire is dominated by small
+    request/response frames, and Nagle coalescing would serialize each
+    one behind the peer's delayed ACK (~40 ms stalls on the serial
+    path). ``SO_SNDBUF``/``SO_RCVBUF`` default to the OS autotuned
+    sizes, which are right for loopback and LAN; set them explicitly
+    (e.g. 1–4 MiB) only for high-BDP WAN links where the pipelined
+    fabric must keep a full window in flight — note Linux doubles the
+    requested value and caps it at ``net.core.{w,r}mem_max``, so a
+    silently clamped setsockopt is worth checking with getsockopt when
+    tuning."""
+    if nodelay:
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    if sndbuf is not None:
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, sndbuf)
+    if rcvbuf is not None:
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, rcvbuf)

@@ -22,6 +22,8 @@ import os
 import socket
 import threading
 import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from struct import error as struct_error
 
 from ..engine import TpuConsensusEngine, VerifiedVoteCache
@@ -54,6 +56,82 @@ class _Peer:
         self.peer_id = peer_id
         self.engine = engine
         self.receiver = receiver
+
+
+class _SerialLane:
+    """Per-connection in-order execution lane over a shared pool: jobs
+    run one at a time in submission order, but on pool threads so the
+    connection's reader keeps draining frames. State-mutating opcodes on
+    a pipelined connection go through this — pipelining removes the
+    round-trip stall WITHOUT reordering a vote stream's chain links."""
+
+    __slots__ = ("_pool", "_jobs", "_lock", "_active")
+
+    def __init__(self, pool: ThreadPoolExecutor):
+        self._pool = pool
+        self._jobs: deque = deque()
+        self._lock = threading.Lock()
+        self._active = False
+
+    def submit(self, job) -> None:
+        with self._lock:
+            self._jobs.append(job)
+            if self._active:
+                return
+            self._active = True
+        try:
+            self._pool.submit(self._drain)
+        except RuntimeError:
+            # Pool shutting down (server stop): run inline on the
+            # connection thread — jobs still execute exactly once, in
+            # order, before the connection unwinds.
+            self._drain()
+
+    def _drain(self) -> None:
+        while True:
+            with self._lock:
+                if not self._jobs:
+                    self._active = False
+                    return
+                job = self._jobs.popleft()
+            try:
+                job()
+            except Exception:  # pragma: no cover - job() handles its own
+                pass
+
+
+class _ConnState:
+    """Per-connection pipelining state (created on HELLO upgrade)."""
+
+    __slots__ = ("write_lock", "inflight", "ordered")
+
+    def __init__(self, pool: ThreadPoolExecutor, max_inflight: int):
+        self.write_lock = threading.Lock()
+        # Bounds concurrently-dispatched frames per connection: when the
+        # window is full the reader blocks HERE instead of queueing
+        # unboundedly — TCP backpressure does the rest.
+        self.inflight = threading.BoundedSemaphore(max_inflight)
+        self.ordered = _SerialLane(pool)
+
+
+# Opcodes that mutate server-side state: on a pipelined connection these
+# execute in receive order (per connection); read-only opcodes dispatch
+# concurrently and may complete out of order. POLL_EVENTS is here
+# because its read is DESTRUCTIVE (it drains the peer's event queue) —
+# two concurrent polls would split the event stream across responses
+# that can arrive in either order.
+_ORDERED_OPCODES = frozenset({
+    P.OP_ADD_PEER,
+    P.OP_CREATE_PROPOSAL,
+    P.OP_CAST_VOTE,
+    P.OP_PROCESS_PROPOSAL,
+    P.OP_PROCESS_VOTE,
+    P.OP_PROCESS_VOTES,
+    P.OP_VOTE_BATCH,
+    P.OP_DELIVER_PROPOSALS,
+    P.OP_HANDLE_TIMEOUT,
+    P.OP_POLL_EVENTS,
+})
 
 
 @contextlib.contextmanager
@@ -121,6 +199,8 @@ class BridgeServer:
         verify_cache: "VerifiedVoteCache | None | str" = "shared",
         health_monitor: "HealthMonitor | None" = None,
         signer_factory: type | None = None,
+        pipeline_workers: int | None = None,
+        max_inflight_per_connection: int = 256,
     ):
         self._host = host
         self._port = port
@@ -216,6 +296,17 @@ class BridgeServer:
         self._sync_lock = threading.Lock()
         self._sync_seq = 0
         self._m_sync_chunks = default_registry.counter(SYNC_CHUNKS_SENT_TOTAL)
+        # Pipelined dispatch: one shared worker pool for every upgraded
+        # connection (HELLO + FEATURE_PIPELINING). Read-only frames run
+        # concurrently on it; mutating frames run through a per-connection
+        # _SerialLane so a pipelined vote stream applies in receive order.
+        # max_inflight_per_connection bounds dispatched-but-unanswered
+        # frames per connection (the reader blocks past it).
+        if pipeline_workers is None:
+            pipeline_workers = min(8, (os.cpu_count() or 2) + 2)
+        self._pipeline_workers = max(1, pipeline_workers)
+        self._max_inflight = max(1, max_inflight_per_connection)
+        self._pipeline_pool: ThreadPoolExecutor | None = None
 
     # ── lifecycle ──────────────────────────────────────────────────────
 
@@ -308,6 +399,10 @@ class BridgeServer:
                 except OSError:
                     pass
                 raise
+        self._pipeline_pool = ThreadPoolExecutor(
+            max_workers=self._pipeline_workers,
+            thread_name_prefix="bridge-pipeline",
+        )
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
         return self.address
@@ -341,6 +436,12 @@ class BridgeServer:
             handlers = list(self._handlers)
         for thread in handlers:
             thread.join(timeout=5)
+        # Pipelined frames that were already dispatched finish on the pool
+        # before the engines are considered quiesced (their responses go
+        # to closed sockets, which is fine — sendall just fails).
+        if self._pipeline_pool is not None:
+            self._pipeline_pool.shutdown(wait=True)
+            self._pipeline_pool = None
         # Flush + close the per-identity WALs, then evict those engines and
         # the peers built on them: a closed WalWriter can never append
         # again, so a restarted server must rebuild each durable engine
@@ -413,10 +514,15 @@ class BridgeServer:
                 pass
 
     def _serve_frames(self, conn: socket.socket) -> None:
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        P.tune_socket(conn)  # TCP_NODELAY: small-frame request wire
+        state: _ConnState | None = None  # non-None once pipelining upgraded
         while self._running:
             try:
-                opcode, cursor = P.read_frame(conn)
+                if state is None:
+                    opcode, cursor = P.read_frame(conn)
+                    corr = 0
+                else:
+                    opcode, corr, cursor = P.read_tagged_frame(conn)
             except (ConnectionError, OSError):
                 return
             except ValueError:
@@ -429,29 +535,116 @@ class BridgeServer:
                 return
             self._m_requests.inc()
             flight_recorder.record("bridge.op", opcode=opcode)
+            if opcode == P.OP_HELLO:
+                granted = self._handle_hello(conn, cursor, state, corr)
+                if granted is None:
+                    return  # write failed; connection is dead
+                if state is None and granted & P.FEATURE_PIPELINING:
+                    pool = self._pipeline_pool
+                    if pool is not None:
+                        state = _ConnState(pool, self._max_inflight)
+                continue
+            if state is None:
+                status, payload = self._safe_dispatch(opcode, cursor)
+                if status >= P.STATUS_UNKNOWN_PEER:
+                    self._m_errors.inc()
+                try:
+                    conn.sendall(P.encode_frame(status, payload))
+                except OSError:
+                    return
+            else:
+                self._dispatch_pipelined(conn, state, opcode, corr, cursor)
+
+    def _handle_hello(
+        self, conn, cursor: P.Cursor, state: "_ConnState | None", corr: int
+    ) -> int | None:
+        """Negotiate features; answer in the connection's CURRENT framing
+        (the mode only switches after the grant is on the wire). Returns
+        the granted bits, or None when the response write failed."""
+        try:
+            cursor.u32()  # client protocol version (1; reserved)
+            offered = cursor.u32()
+        except ValueError:
+            offered = 0
+        granted = offered & P.SUPPORTED_FEATURES
+        if self._pipeline_pool is None:
+            granted &= ~P.FEATURE_PIPELINING  # not started / stopping
+        payload = P.u32(P.PROTOCOL_VERSION) + P.u32(granted)
+        try:
+            if state is None:
+                conn.sendall(P.encode_frame(P.STATUS_OK, payload))
+            else:
+                # Re-HELLO on an upgraded connection: answer tagged; the
+                # connection stays pipelined (no downgrade path).
+                with state.write_lock:
+                    conn.sendall(
+                        P.encode_tagged_frame(P.STATUS_OK, corr, payload)
+                    )
+        except OSError:
+            return None
+        return granted
+
+    def _safe_dispatch(self, opcode: int, cursor: P.Cursor) -> tuple[int, bytes]:
+        """_dispatch with the wire's error contract applied (one home for
+        the serial loop and the pipelined workers)."""
+        try:
+            return self._dispatch(opcode, cursor)
+        except ConsensusError as exc:
+            return int(exc.code), P.string(str(exc))
+        except (ValueError, KeyError, struct_error) as exc:
+            flight_recorder.record(
+                "bridge.bad_request", opcode=opcode, error=str(exc)
+            )
+            return P.STATUS_BAD_REQUEST, P.string(str(exc))
+        except Exception as exc:  # pragma: no cover - defensive
+            # Dispatch blew up unexpectedly (a peer engine died, a bug):
+            # preserve the ring for the postmortem before answering.
+            flight_recorder.record(
+                "bridge.dispatch_error", opcode=opcode, error=repr(exc)
+            )
+            flight_recorder.dump("bridge-dispatch-error")
+            return P.STATUS_INTERNAL, P.string(repr(exc))
+
+    def _dispatch_pipelined(
+        self,
+        conn: socket.socket,
+        state: _ConnState,
+        opcode: int,
+        corr: int,
+        cursor: P.Cursor,
+    ) -> None:
+        """Hand one tagged frame to the worker pool and return to the
+        read loop. Mutating opcodes run on the connection's serial lane
+        (receive order); read-only opcodes run concurrently, so their
+        responses can overtake — the client matches by correlation id."""
+        state.inflight.acquire()  # reader blocks when the window is full
+
+        def run() -> None:
             try:
-                status, payload = self._dispatch(opcode, cursor)
-            except ConsensusError as exc:
-                status, payload = int(exc.code), P.string(str(exc))
-            except (ValueError, KeyError, struct_error) as exc:
-                status, payload = P.STATUS_BAD_REQUEST, P.string(str(exc))
-                flight_recorder.record(
-                    "bridge.bad_request", opcode=opcode, error=str(exc)
-                )
-            except Exception as exc:  # pragma: no cover - defensive
-                status, payload = P.STATUS_INTERNAL, P.string(repr(exc))
-                # Dispatch blew up unexpectedly (a peer engine died, a bug):
-                # preserve the ring for the postmortem before answering.
-                flight_recorder.record(
-                    "bridge.dispatch_error", opcode=opcode, error=repr(exc)
-                )
-                flight_recorder.dump("bridge-dispatch-error")
-            if status >= P.STATUS_UNKNOWN_PEER:
-                self._m_errors.inc()
-            try:
-                conn.sendall(P.encode_frame(status, payload))
-            except OSError:
+                status, payload = self._safe_dispatch(opcode, cursor)
+                if status >= P.STATUS_UNKNOWN_PEER:
+                    self._m_errors.inc()
+                try:
+                    with state.write_lock:
+                        conn.sendall(
+                            P.encode_tagged_frame(status, corr, payload)
+                        )
+                except OSError:
+                    pass  # connection died; nothing to answer to
+            finally:
+                state.inflight.release()
+
+        if opcode in _ORDERED_OPCODES:
+            state.ordered.submit(run)
+        else:
+            pool = self._pipeline_pool
+            if pool is None:
+                run()
                 return
+            try:
+                pool.submit(run)
+            except RuntimeError:
+                run()  # pool shut down mid-flight: answer inline
 
     # ── dispatch ───────────────────────────────────────────────────────
 
@@ -466,6 +659,9 @@ class BridgeServer:
             return P.STATUS_OK, P.blob(
                 default_registry.render_prometheus().encode("utf-8")
             )
+        if opcode == P.OP_VOTE_BATCH:
+            # Multi-peer frame: groups carry their own peer ids.
+            return self._op_vote_batch(c)
         handler = _HANDLERS.get(opcode)
         if handler is None:
             return P.STATUS_UNKNOWN_OPCODE, b""
@@ -579,6 +775,14 @@ class BridgeServer:
         with self._lock:
             return self._durable.get(identity)
 
+    def peer_engine(self, peer_id: int):
+        """The engine serving ``peer_id`` (None = unknown peer). Benches
+        and fabric smoke tests use it to fingerprint a bridged peer's
+        state without going through a durable identity."""
+        with self._lock:
+            peer = self._peers.get(peer_id)
+            return None if peer is None else peer.engine
+
     def recovery_stats(self, identity: bytes):
         """:class:`~hashgraph_tpu.wal.ReplayStats` from the WAL recovery
         that backed ``identity``'s engine (None = identity unknown or not
@@ -672,6 +876,86 @@ class BridgeServer:
                 statuses[i] = int(status) & 0xFF
         return P.STATUS_OK, P.u32(count) + bytes(statuses)
 
+    # Stage size for a coalesced frame's pipelined ingest: big enough to
+    # amortize the per-dispatch fixed cost, small enough that multi-stage
+    # frames overlap crypto with apply.
+    _PIPELINE_SPLIT = 256
+
+    def _op_vote_batch(self, c: P.Cursor) -> tuple[int, bytes]:
+        """Coalesced columnar vote frame (``OP_VOTE_BATCH``): many
+        (peer_id, scope) groups of small vote payloads land in ONE frame
+        and ONE pipelined engine dispatch per peer —
+        :meth:`TpuConsensusEngine.ingest_votes_pipelined` overlaps group
+        k+1's signature prepass with group k's apply. Per-vote statuses
+        come back in flattened batch order; an undecodable blob marks
+        its row 241 and an unknown peer_id marks its group's rows
+        STATUS_UNKNOWN_PEER, neither poisoning the rest of the frame."""
+        now, groups = P.decode_vote_batch(c)
+        total = sum(len(votes) for _, _, votes in groups)
+        statuses = bytearray([P.STATUS_BAD_REQUEST]) * total
+        # Per engine: ONE flattened batch across all of the peer's groups
+        # (ingest_votes handles heterogeneous scopes in one dispatch, and
+        # the fixed dispatch cost dominates small batches — merging is a
+        # ~3x server-side win over per-group dispatches at 64-vote
+        # groups), split into _PIPELINE_SPLIT-vote stages so big frames
+        # still overlap stage k+1's signature prepass with stage k's
+        # apply. Flattened-in-group-order ≡ per-group sequential calls
+        # (ingest_votes applies items strictly in order), so coalescing
+        # never reorders a chain. Row indices ride along so statuses land
+        # back in flattened frame order.
+        per_peer: dict[int, tuple[list[int], list[tuple[str, Vote]]]] = {}
+        offset = 0
+        for peer_id, scope, votes in groups:
+            rows, batch = per_peer.setdefault(peer_id, ([], []))
+            for j, blob in enumerate(votes):
+                try:
+                    batch.append((scope, Vote.decode(blob)))
+                    rows.append(offset + j)
+                except (ValueError, IndexError):
+                    pass  # row already 241
+            offset += len(votes)
+        for peer_id, (rows, batch) in per_peer.items():
+            peer = self._peers.get(peer_id)
+            if peer is None:
+                for row in rows:
+                    statuses[row] = P.STATUS_UNKNOWN_PEER
+                continue
+            stages = [
+                batch[i : i + self._PIPELINE_SPLIT]
+                for i in range(0, len(batch), self._PIPELINE_SPLIT)
+            ]
+            results = peer.engine.ingest_votes_pipelined(stages, now)
+            codes = [code for stage in results for code in stage]
+            for row, code in zip(rows, codes):
+                statuses[row] = int(code) & 0xFF
+        return P.STATUS_OK, P.u32(total) + bytes(statuses)
+
+    def _op_deliver_proposals(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        """Anti-entropy delivery (``OP_DELIVER_PROPOSALS``): lands on
+        :meth:`TpuConsensusEngine.deliver_proposals` — unknown sessions
+        are created, known ones extend along the validated-chain
+        watermark (suffix-only crypto), redeliveries settle crypto-free
+        as PROPOSAL_ALREADY_EXIST. Per-item statuses in batch order;
+        an undecodable blob marks its row 241."""
+        now = c.u64()
+        count = c.u32()
+        statuses = bytearray([P.STATUS_BAD_REQUEST]) * count
+        items: list[tuple[int, str, Proposal]] = []
+        for i in range(count):
+            scope = c.string()
+            blob = c.blob()
+            try:
+                items.append((i, scope, Proposal.decode(blob)))
+            except (ValueError, IndexError):
+                pass
+        if items:
+            codes = peer.engine.deliver_proposals(
+                [(scope, proposal) for _, scope, proposal in items], now
+            )
+            for (i, _, _), code in zip(items, codes):
+                statuses[i] = int(code) & 0xFF
+        return P.STATUS_OK, P.u32(count) + bytes(statuses)
+
     def _op_handle_timeout(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
         scope = c.string()
         pid = c.u32()
@@ -697,8 +981,19 @@ class BridgeServer:
         return P.STATUS_OK, P.u8(P.RESULT_YES if result else P.RESULT_NO)
 
     def _op_poll_events(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        # Optional trailing u32 bound (FEATURE_EVENT_BOUND): a fabric
+        # event pump polling many peers caps each drain so one hot peer
+        # cannot monopolize the window. Bounded requests get a trailing
+        # u8 ``more`` flag (conservative: set when the bound stopped the
+        # drain, so the pump polls again immediately; an empty receiver
+        # on the next poll costs one frame, not a missed event).
+        max_events = c.u32() if c.remaining() >= 4 else None
         events: list[tuple[str, ConsensusEvent]] = []
+        more = False
         while True:
+            if max_events is not None and len(events) >= max_events:
+                more = True
+                break
             item = peer.receiver.try_recv()
             if item is None:
                 break
@@ -724,6 +1019,8 @@ class BridgeServer:
                     + P.u8(0)
                     + P.u64(event.timestamp)
                 )
+        if max_events is not None:
+            out.append(P.u8(1 if more else 0))
         return P.STATUS_OK, b"".join(out)
 
     def _op_get_proposal(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
@@ -889,6 +1186,14 @@ class BridgeServer:
         out.append(P.u8(1 if more else 0))
         return P.STATUS_OK, b"".join(out)
 
+    def _op_state_fingerprint(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        """Order-insensitive content digest of the peer's full tracked
+        state (``sync.state_fingerprint``) — lets a remote driver assert
+        cross-peer convergence without reaching into the process."""
+        from ..sync.snapshot import state_fingerprint
+
+        return P.STATUS_OK, P.string(state_fingerprint(peer.engine))
+
     def _op_explain(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
         """Decision provenance as one JSON blob (see
         ``TpuConsensusEngine.explain_decision``); durable peers overlay
@@ -916,4 +1221,6 @@ _HANDLERS = {
     P.OP_SYNC_MANIFEST: BridgeServer._op_sync_manifest,
     P.OP_SYNC_CHUNK: BridgeServer._op_sync_chunk,
     P.OP_WAL_TAIL: BridgeServer._op_wal_tail,
+    P.OP_DELIVER_PROPOSALS: BridgeServer._op_deliver_proposals,
+    P.OP_STATE_FINGERPRINT: BridgeServer._op_state_fingerprint,
 }

@@ -3408,6 +3408,14 @@ class TpuConsensusEngine(Generic[Scope]):
             "voter_capacity": self._pool.voter_capacity,
         }
 
+    def session_keys(self) -> "list[tuple[Scope, int]]":
+        """Every tracked ``(scope, proposal_id)`` in one consistent read —
+        the enumeration a gossip node needs to bootstrap its anti-entropy
+        bookkeeping after installing state it did not ingest itself
+        (catch-up, storage load)."""
+        with self._lock:
+            return list(self._index.keys())
+
     def export_session(self, scope: Scope, proposal_id: int) -> ConsensusSession:
         """Materialise a scalar ConsensusSession from the pooled state —
         the bridge back to ConsensusStorage backends (checkpoint/interop).

@@ -1,0 +1,28 @@
+"""Gossip fabric, phase 1: multiplexed pipelined transport.
+
+The bridge (:mod:`hashgraph_tpu.bridge`) is the FFI boundary — strictly
+request/response, one frame at a time. This package is the throughput
+layer on top of the SAME wire protocol: a selectors-based event-loop
+transport with connection multiplexing and frame pipelining
+(:class:`GossipTransport`), send-side vote coalescing into columnar
+batch frames (:class:`VoteCoalescer`), and a :class:`GossipNode` that
+fans deliveries to a sampled peer subset and repairs divergence with
+periodic anti-entropy over the engine's validated-chain watermark,
+escalating far-behind peers to the state-sync catch-up path.
+
+Feature negotiation (``OP_HELLO``) keeps old and new peers
+interoperable in both directions; see
+:mod:`hashgraph_tpu.bridge.protocol` for the wire additions.
+"""
+
+from .coalescer import VoteCoalescer
+from .node import GossipNode
+from .transport import ChannelBusy, GossipTransport, PeerChannel
+
+__all__ = [
+    "ChannelBusy",
+    "GossipNode",
+    "GossipTransport",
+    "PeerChannel",
+    "VoteCoalescer",
+]

@@ -1,0 +1,477 @@
+"""Selectors-based multiplexed transport for the gossip fabric.
+
+One :class:`GossipTransport` drives MANY peer connections from ONE
+event-loop thread (`selectors`, non-blocking sockets — no asyncio in the
+library core, matching the reference's no-I/O embedder contract: the
+loop is plain stdlib an embedder can reason about and replace). Each
+:class:`PeerChannel` speaks the bridge wire protocol with the features
+its server granted at HELLO:
+
+- against a new server (``FEATURE_PIPELINING``): tagged frames, many in
+  flight, responses matched by correlation id;
+- against an OLD server: the one-at-a-time framing with a FIFO response
+  match and an in-flight window of 1 — same API, interop preserved.
+
+**Backpressure is explicit and bounded.** Every channel has a byte-capped
+send queue and a credit window (``max_inflight`` unanswered requests).
+Credits gate *sending* — queued frames wait; once the queue's byte cap
+would be exceeded, :meth:`GossipTransport.try_request` refuses the frame
+(*sheds*) instead of buffering without bound. The caller — the
+:class:`~hashgraph_tpu.gossip.node.GossipNode` — records what it shed
+and repairs via anti-entropy later, so a slow peer costs a bounded queue
+plus deferred repair, never ballooning memory.
+
+A dropped connection fails every queued and in-flight future with
+:class:`~hashgraph_tpu.bridge.client.BridgeConnectionLost` — a typed,
+per-request signal, never a silent hang.
+"""
+
+from __future__ import annotations
+
+import selectors
+import socket
+import threading
+import weakref
+from collections import deque
+from concurrent.futures import Future
+
+from ..bridge import protocol as P
+from ..bridge.client import BridgeConnectionLost, BridgeError
+from ..obs import (
+    GOSSIP_FRAMES_SENT_TOTAL,
+    GOSSIP_FRAMES_SHED_TOTAL,
+    GOSSIP_INFLIGHT_REQUESTS,
+    GOSSIP_SEND_QUEUE_BYTES,
+    flight_recorder,
+)
+from ..obs import registry as default_registry
+
+_RECV_CHUNK = 256 * 1024
+
+
+def _weak_sample(ref, method_name):
+    """Gauge provider over a weakly-referenced transport (0 once dead)."""
+
+    def sample():
+        transport = ref()
+        return 0 if transport is None else getattr(transport, method_name)()
+
+    return sample
+
+
+class PeerChannel:
+    """One multiplexed connection to a peer's bridge server. Owned by a
+    :class:`GossipTransport`; all socket I/O happens on the transport's
+    event-loop thread, callers only enqueue frames and await futures."""
+
+    def __init__(self, name: str, sock: socket.socket, features: int,
+                 max_inflight: int, max_queue_bytes: int):
+        self.name = name
+        self.sock = sock
+        self.features = features
+        self.pipelined = bool(features & P.FEATURE_PIPELINING)
+        self.max_inflight = max_inflight if self.pipelined else 1
+        self.max_queue_bytes = max_queue_bytes
+        self.alive = True
+        self.error: Exception | None = None
+        # Guarded by the channel lock: send queue + accounting. Frames
+        # are fully encoded at enqueue time (the loop thread only moves
+        # bytes).
+        self.lock = threading.Lock()
+        self.sendq: deque[tuple[bytes, Future]] = deque()
+        self.queue_bytes = 0
+        self.shed_total = 0
+        # Loop-thread-only state: the frame currently being written and
+        # the unanswered requests. Tagged channels match by correlation
+        # id; untagged channels complete FIFO.
+        self.outbuf: memoryview | None = None
+        self.outfut: Future | None = None
+        self.inflight: dict[int, Future] = {}
+        self.fifo: deque[Future] = deque()
+        self.next_corr = 0
+        self.rbuf = bytearray()
+
+    # ── accounting (any thread) ────────────────────────────────────────
+
+    def inflight_count(self) -> int:
+        return len(self.inflight) + len(self.fifo) + (
+            1 if self.outfut is not None else 0
+        )
+
+    def stats(self) -> dict:
+        with self.lock:
+            return {
+                "alive": self.alive,
+                "pipelined": self.pipelined,
+                "queue_frames": len(self.sendq),
+                "queue_bytes": self.queue_bytes,
+                "inflight": self.inflight_count(),
+                "shed_total": self.shed_total,
+            }
+
+
+class GossipTransport:
+    """Multiplexed, pipelined fan-out over many bridge connections.
+
+    ``connect`` performs the blocking HELLO handshake, then hands the
+    socket to the event loop. ``try_request`` enqueues one frame for a
+    peer and returns a future resolving to the response payload cursor
+    (or raising :class:`BridgeError` / :class:`BridgeConnectionLost`) —
+    or returns ``None`` when the peer's send queue is at its byte cap
+    (the shed signal). All sockets run ``TCP_NODELAY``; pass ``sndbuf``/
+    ``rcvbuf`` for high-BDP links (see :func:`bridge.protocol.tune_socket`).
+    """
+
+    def __init__(
+        self,
+        *,
+        max_inflight: int = 128,
+        max_queue_bytes: int = 4 * 1024 * 1024,
+        connect_timeout: float = 5.0,
+        features: int = P.SUPPORTED_FEATURES,
+        sndbuf: int | None = None,
+        rcvbuf: int | None = None,
+    ):
+        self._max_inflight = max_inflight
+        self._max_queue_bytes = max_queue_bytes
+        self._connect_timeout = connect_timeout
+        self._features = features
+        self._sndbuf = sndbuf
+        self._rcvbuf = rcvbuf
+        self._sel = selectors.DefaultSelector()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, None)
+        self._channels: dict[str, PeerChannel] = {}
+        self._pending_register: list[PeerChannel] = []
+        self._lock = threading.Lock()
+        self._running = True
+        self._m_sent = default_registry.counter(GOSSIP_FRAMES_SENT_TOTAL)
+        self._m_shed = default_registry.counter(GOSSIP_FRAMES_SHED_TOTAL)
+        # Providers close over a WEAK ref (the engine/WAL convention): a
+        # bound method's __self__ would strongly pin every transport ever
+        # created into the process-global registry — the owner weakref
+        # only prunes the entry once the owner can actually die.
+        ref = weakref.ref(self)
+        default_registry.gauge(GOSSIP_SEND_QUEUE_BYTES).add_provider(
+            _weak_sample(ref, "_total_queue_bytes"), owner=self
+        )
+        default_registry.gauge(GOSSIP_INFLIGHT_REQUESTS).add_provider(
+            _weak_sample(ref, "_total_inflight"), owner=self
+        )
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="gossip-transport"
+        )
+        self._thread.start()
+
+    # ── lifecycle ──────────────────────────────────────────────────────
+
+    def close(self) -> None:
+        self._running = False
+        self._wake()
+        self._thread.join(timeout=5)
+        with self._lock:
+            channels = list(self._channels.values())
+        for ch in channels:
+            self._kill_channel(
+                ch, BridgeConnectionLost("transport closed"), record=False
+            )
+        try:
+            self._wake_r.close()
+            self._wake_w.close()
+        except OSError:
+            pass
+        self._sel.close()
+
+    def __enter__(self) -> "GossipTransport":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"\x00")
+        except OSError:
+            pass
+
+    # ── connections ────────────────────────────────────────────────────
+
+    def connect(self, name: str, host: str, port: int) -> PeerChannel:
+        """Open (blocking) a channel to a peer's bridge server and
+        negotiate features; the socket then joins the event loop. A
+        ``name`` can be reconnected after its channel died — the dead
+        channel is replaced."""
+        if not self._running:
+            raise RuntimeError("transport is closed")
+        sock = socket.create_connection(
+            (host, port), timeout=self._connect_timeout
+        )
+        P.tune_socket(sock, sndbuf=self._sndbuf, rcvbuf=self._rcvbuf)
+        features = 0
+        try:
+            sock.sendall(P.encode_frame(
+                P.OP_HELLO,
+                P.u32(P.PROTOCOL_VERSION) + P.u32(self._features),
+            ))
+            status, cursor = P.read_frame(sock)
+            if status == P.STATUS_OK:
+                cursor.u32()  # server protocol version
+                features = cursor.u32()
+            elif status != P.STATUS_UNKNOWN_OPCODE:
+                raise BridgeError(status)
+        except BaseException:
+            sock.close()
+            raise
+        sock.setblocking(False)
+        channel = PeerChannel(
+            name, sock, features, self._max_inflight, self._max_queue_bytes
+        )
+        with self._lock:
+            old = self._channels.get(name)
+            if old is not None and old.alive:
+                sock.close()
+                raise ValueError(f"peer {name!r} already connected")
+            self._channels[name] = channel
+            self._pending_register.append(channel)
+        self._wake()
+        return channel
+
+    def channel(self, name: str) -> PeerChannel | None:
+        with self._lock:
+            return self._channels.get(name)
+
+    def stats(self) -> dict:
+        with self._lock:
+            channels = dict(self._channels)
+        return {name: ch.stats() for name, ch in channels.items()}
+
+    # ── requests ───────────────────────────────────────────────────────
+
+    def try_request(
+        self, name: str, opcode: int, payload: bytes = b""
+    ) -> Future | None:
+        """Enqueue one request for ``name``; None = shed (queue at its
+        byte cap — bounded backpressure, the caller repairs later)."""
+        with self._lock:
+            channel = self._channels.get(name)
+        if channel is None:
+            raise KeyError(f"unknown peer {name!r}")
+        if not channel.alive:
+            future: Future = Future()
+            future.set_exception(
+                channel.error
+                or BridgeConnectionLost(f"peer {name!r} disconnected")
+            )
+            return future
+        if channel.pipelined:
+            with channel.lock:
+                corr = channel.next_corr
+                channel.next_corr = (corr + 1) & 0xFFFFFFFF
+            frame = P.encode_tagged_frame(opcode, corr, payload)
+        else:
+            frame = P.encode_frame(opcode, payload)
+        future = Future()
+        with channel.lock:
+            # Re-checked under the SAME lock _kill_channel drains the
+            # queue with: without this, a frame enqueued between the
+            # loop thread's kill-drain and our append would sit on a
+            # dead channel with its future never resolved.
+            if not channel.alive:
+                future.set_exception(
+                    channel.error
+                    or BridgeConnectionLost(f"peer {name!r} disconnected")
+                )
+                return future
+            if channel.queue_bytes + len(frame) > channel.max_queue_bytes:
+                channel.shed_total += 1
+                self._m_shed.inc()
+                flight_recorder.record(
+                    "gossip.shed", peer=name, opcode=opcode,
+                    queue_bytes=channel.queue_bytes,
+                )
+                return None
+            channel.sendq.append((frame, future))
+            channel.queue_bytes += len(frame)
+        self._wake()
+        return future
+
+    def request(self, name: str, opcode: int, payload: bytes = b"") -> Future:
+        """:meth:`try_request` that raises :class:`ChannelBusy` instead
+        of returning None — for control traffic the caller windows
+        itself (anti-entropy sends one frame and awaits it)."""
+        future = self.try_request(name, opcode, payload)
+        if future is None:
+            raise ChannelBusy(f"peer {name!r} send queue is full")
+        return future
+
+    # ── gauge providers ────────────────────────────────────────────────
+
+    def _total_queue_bytes(self) -> int:
+        with self._lock:
+            channels = list(self._channels.values())
+        return sum(ch.queue_bytes for ch in channels)
+
+    def _total_inflight(self) -> int:
+        with self._lock:
+            channels = list(self._channels.values())
+        return sum(ch.inflight_count() for ch in channels)
+
+    # ── event loop (loop thread only below) ────────────────────────────
+
+    def _loop(self) -> None:
+        while self._running:
+            self._register_pending()
+            self._refresh_interest()
+            for key, mask in self._sel.select(timeout=0.1):
+                if key.data is None:  # wake pipe
+                    try:
+                        while self._wake_r.recv(4096):
+                            pass
+                    except (BlockingIOError, OSError):
+                        pass
+                    continue
+                channel: PeerChannel = key.data
+                try:
+                    if mask & selectors.EVENT_WRITE:
+                        self._on_writable(channel)
+                    if mask & selectors.EVENT_READ:
+                        self._on_readable(channel)
+                except (ConnectionError, OSError, ValueError) as exc:
+                    self._kill_channel(channel, BridgeConnectionLost(
+                        f"peer {channel.name!r} connection lost: {exc}"
+                    ))
+
+    def _register_pending(self) -> None:
+        with self._lock:
+            fresh = self._pending_register
+            self._pending_register = []
+        for channel in fresh:
+            if channel.alive:
+                self._sel.register(channel.sock, selectors.EVENT_READ, channel)
+
+    def _refresh_interest(self) -> None:
+        with self._lock:
+            channels = list(self._channels.values())
+        for channel in channels:
+            if not channel.alive:
+                continue
+            want = selectors.EVENT_READ
+            credits = channel.max_inflight - channel.inflight_count()
+            with channel.lock:
+                has_frames = bool(channel.sendq) or channel.outbuf is not None
+            if has_frames and (credits > 0 or channel.outbuf is not None):
+                want |= selectors.EVENT_WRITE
+            try:
+                self._sel.modify(channel.sock, want, channel)
+            except (KeyError, ValueError):
+                pass  # not registered yet / already unregistered
+
+    def _on_writable(self, channel: PeerChannel) -> None:
+        while True:
+            if channel.outbuf is None:
+                credits = channel.max_inflight - channel.inflight_count()
+                if credits <= 0:
+                    return
+                with channel.lock:
+                    if not channel.sendq:
+                        return
+                    frame, future = channel.sendq.popleft()
+                    channel.queue_bytes -= len(frame)
+                channel.outbuf = memoryview(frame)
+                channel.outfut = future
+            sent = channel.sock.send(channel.outbuf)
+            if sent < len(channel.outbuf):
+                channel.outbuf = channel.outbuf[sent:]
+                return  # kernel buffer full; resume on next writable
+            # Frame fully handed to the kernel: it is now in flight.
+            frame_bytes = channel.outbuf.obj
+            future = channel.outfut
+            channel.outbuf = None
+            channel.outfut = None
+            self._m_sent.inc()
+            if channel.pipelined:
+                corr = P._U32.unpack_from(frame_bytes, 5)[0]
+                channel.inflight[corr] = future
+            else:
+                channel.fifo.append(future)
+
+    def _on_readable(self, channel: PeerChannel) -> None:
+        chunk = channel.sock.recv(_RECV_CHUNK)
+        if not chunk:
+            raise ConnectionError("peer closed the connection")
+        channel.rbuf += chunk
+        buf = channel.rbuf
+        pos = 0
+        while True:
+            if len(buf) - pos < 4:
+                break
+            (length,) = P._U32.unpack_from(buf, pos)
+            if length < 1 or length > P.MAX_FRAME:
+                raise ValueError(f"bad frame length {length}")
+            if len(buf) - pos < 4 + length:
+                break
+            body = bytes(buf[pos + 4 : pos + 4 + length])
+            pos += 4 + length
+            self._complete(channel, body)
+        if pos:
+            del buf[:pos]
+
+    def _complete(self, channel: PeerChannel, body: bytes) -> None:
+        status, corr, cursor = P.parse_frame(body, channel.pipelined)
+        if channel.pipelined:
+            future = channel.inflight.pop(corr, None)
+        else:
+            future = channel.fifo.popleft() if channel.fifo else None
+        if future is None:
+            return  # response to nothing we sent; drop
+        if status == P.STATUS_OK:
+            future.set_result(cursor)
+        else:
+            message = ""
+            try:
+                message = cursor.string()
+            except ValueError:
+                pass
+            future.set_exception(BridgeError(status, message))
+
+    def _kill_channel(
+        self, channel: PeerChannel, error: Exception, record: bool = True
+    ) -> None:
+        if not channel.alive:
+            return
+        channel.alive = False
+        channel.error = error
+        try:
+            self._sel.unregister(channel.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            channel.sock.close()
+        except OSError:
+            pass
+        with channel.lock:
+            queued = [future for _, future in channel.sendq]
+            channel.sendq.clear()
+            channel.queue_bytes = 0
+        pending = list(channel.inflight.values()) + list(channel.fifo)
+        channel.inflight.clear()
+        channel.fifo.clear()
+        if channel.outfut is not None:
+            pending.append(channel.outfut)
+            channel.outbuf = None
+            channel.outfut = None
+        if record:
+            flight_recorder.record(
+                "gossip.peer_lost", peer=channel.name,
+                pending=len(pending) + len(queued), error=str(error),
+            )
+        for future in pending + queued:
+            if not future.done():
+                future.set_exception(error)
+
+
+class ChannelBusy(RuntimeError):
+    """``request`` refused a frame because the peer's bounded send queue
+    is full — the explicit backpressure signal for callers that must not
+    shed silently."""

@@ -61,6 +61,14 @@ hashgraph_sync_chunks_sent_total                counter    bridge sync source (s
 hashgraph_sync_chunks_received_total            counter    CatchUpClient (snapshot chunks verified)
 hashgraph_sync_tail_records_total               counter    CatchUpClient (WAL tail records applied)
 hashgraph_sync_catchup_seconds                  histogram  CatchUpClient (end-to-end catch-up)
+hashgraph_gossip_frames_sent_total              counter    gossip transport (multiplexed frames out)
+hashgraph_gossip_frames_shed_total              counter    gossip transport (backpressure sheds)
+hashgraph_gossip_votes_coalesced_total          counter    vote coalescer (votes packed into batch frames)
+hashgraph_gossip_send_queue_bytes               gauge      gossip transport send queues (scrape-time)
+hashgraph_gossip_inflight_requests              gauge      gossip transport unanswered requests (scrape-time)
+hashgraph_gossip_anti_entropy_rounds_total      counter    GossipNode anti-entropy rounds
+hashgraph_gossip_anti_entropy_sessions_total    counter    GossipNode sessions pushed by anti-entropy
+hashgraph_gossip_catchup_escalations_total      counter    GossipNode escalations to CatchUpClient
 ==============================================  =========  ==================
 """
 
@@ -170,6 +178,22 @@ SYNC_CHUNKS_RECEIVED_TOTAL = "hashgraph_sync_chunks_received_total"
 SYNC_TAIL_RECORDS_TOTAL = "hashgraph_sync_tail_records_total"
 SYNC_CATCHUP_SECONDS = "hashgraph_sync_catchup_seconds"
 
+# Gossip fabric (gossip.transport / gossip.node): multiplexed frames
+# sent and shed (backpressure), votes packed by the coalescer, live
+# send-queue bytes + in-flight requests across every transport (provider
+# gauges), anti-entropy rounds/sessions pushed, and catch-up escalations
+# of far-behind peers to the state-sync path.
+GOSSIP_FRAMES_SENT_TOTAL = "hashgraph_gossip_frames_sent_total"
+GOSSIP_FRAMES_SHED_TOTAL = "hashgraph_gossip_frames_shed_total"
+GOSSIP_VOTES_COALESCED_TOTAL = "hashgraph_gossip_votes_coalesced_total"
+GOSSIP_SEND_QUEUE_BYTES = "hashgraph_gossip_send_queue_bytes"
+GOSSIP_INFLIGHT_REQUESTS = "hashgraph_gossip_inflight_requests"
+GOSSIP_ANTI_ENTROPY_ROUNDS_TOTAL = "hashgraph_gossip_anti_entropy_rounds_total"
+GOSSIP_ANTI_ENTROPY_SESSIONS_TOTAL = (
+    "hashgraph_gossip_anti_entropy_sessions_total"
+)
+GOSSIP_CATCHUP_ESCALATIONS_TOTAL = "hashgraph_gossip_catchup_escalations_total"
+
 # Process-wide default registry (mirrors tracing.tracer's role).
 registry = MetricsRegistry()
 
@@ -202,6 +226,8 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         TRACKED_PEERS,
         EVIDENCE_RECORDS,
         STALE_PEERS,
+        GOSSIP_SEND_QUEUE_BYTES,
+        GOSSIP_INFLIGHT_REQUESTS,
     ):
         reg.gauge(name)
     for name in (
@@ -230,6 +256,12 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         SYNC_CHUNKS_SENT_TOTAL,
         SYNC_CHUNKS_RECEIVED_TOTAL,
         SYNC_TAIL_RECORDS_TOTAL,
+        GOSSIP_FRAMES_SENT_TOTAL,
+        GOSSIP_FRAMES_SHED_TOTAL,
+        GOSSIP_VOTES_COALESCED_TOTAL,
+        GOSSIP_ANTI_ENTROPY_ROUNDS_TOTAL,
+        GOSSIP_ANTI_ENTROPY_SESSIONS_TOTAL,
+        GOSSIP_CATCHUP_ESCALATIONS_TOTAL,
     ):
         reg.counter(name)
     reg.info(BUILD_INFO).set(
