@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-catchup bench-gossip fleet-smoke catchup-smoke gossip-smoke metrics-smoke trace-smoke smoke
+.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-catchup bench-gossip bench-chaos fleet-smoke catchup-smoke gossip-smoke chaos-smoke metrics-smoke trace-smoke smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -68,6 +68,22 @@ bench-gossip:
 # state fingerprint-identical across peers.
 gossip-smoke:
 	JAX_PLATFORMS=cpu python bench.py gossip --smoke
+
+# Deterministic chaos harness, full depth: the scenario corpus
+# (partitions incl. asymmetric, drop/dup/reorder storms, kill-9
+# crash-restart via WAL recovery, lost-disk catch-up, equivocators,
+# forkers, expired-spam + signature-burst) at 5 pinned seeds, three
+# machine-checked verdicts per run (convergence, exact-culprit
+# accountability, honest-decision safety) + the blindness self-test.
+bench-chaos:
+	JAX_PLATFORMS=cpu python bench.py chaos
+
+# CI short run: the same corpus at 3 pinned seeds. Seed-deterministic:
+# a failure here is a reproducible regression (re-run the same seed),
+# never a flake. The JSON line carries the machine-readable
+# `scenarios: {passed, failed, seeds}` block.
+chaos-smoke:
+	JAX_PLATFORMS=cpu python bench.py chaos --smoke
 
 # End-to-end observability check: start a bridge server (WAL + HTTP
 # sidecar), drive a proposal to decision, scrape /metrics + /healthz and

@@ -1957,6 +1957,69 @@ def run_catchup(
     }
 
 
+def run_chaos(smoke: bool = False, seeds: "list[int] | None" = None) -> dict:
+    """Deterministic chaos harness: the full scenario corpus
+    (hashgraph_tpu.sim) at pinned seeds, plus the blindness self-test.
+
+    Every scenario must pass all three machine-checked verdicts —
+    convergence (honest state-fingerprint equality), accountability
+    (exactly the injected culprits convicted, offline-verifiable
+    evidence, zero honest convictions), safety (no divergent honest
+    decisions) — and a run is a pure function of its seed, so a failure
+    here is a deterministic regression, never a flake. ``--smoke`` is
+    the CI shape (3 pinned seeds); the full mode adds two more. The
+    ``scenarios: {passed, failed, seeds}`` block is the machine-readable
+    summary downstream tooling keys on."""
+    import time as _time
+
+    from hashgraph_tpu.sim import SCENARIOS, run_corpus, run_scenario
+
+    if seeds is None:
+        seeds = [7, 99, 1234] if smoke else [7, 99, 1234, 31337, 777]
+    t0 = _time.perf_counter()
+    corpus = run_corpus(seeds)
+    # The harness must be able to detect its own blindness: a run with
+    # the evidence layer disabled HAS to fail accountability, or every
+    # green corpus above is meaningless.
+    blind = run_scenario("equivocator", seeds[0], blind=True)
+    blind_ok = (
+        not blind["passed"]
+        and not blind["verdicts"]["accountability"]["ok"]
+        and bool(blind["verdicts"]["accountability"]["missed_culprits"])
+    )
+    seconds = round(_time.perf_counter() - t0, 3)
+    total = corpus["scenarios"]["passed"] + corpus["scenarios"]["failed"]
+    # Gate hard, like every other smoke bench: a failed scenario or a
+    # blindness self-test that passes (i.e. fails to fail) must exit the
+    # runner non-zero or the CI job cannot hold the line. The assert
+    # message names the (scenario, seed) pairs — each reproduces
+    # byte-for-byte from its seed.
+    assert not corpus["failures"], (
+        "chaos scenarios FAILED (deterministic — rerun these seeds): "
+        + ", ".join(
+            f"{f['scenario']}@{f['seed']}" for f in corpus["failures"]
+        )
+    )
+    assert blind_ok, (
+        "blindness self-test failed: a run with the evidence layer "
+        "disabled did NOT fail the accountability verdict — the harness "
+        "cannot detect its own blindness"
+    )
+    return {
+        "metric": "chaos_scenarios_passed",
+        "value": corpus["scenarios"]["passed"],
+        "unit": f"of {total} scenario-runs",
+        "detail": {
+            "scenarios": corpus["scenarios"],
+            "corpus": sorted(SCENARIOS),
+            "results": corpus["results"],
+            "failures": corpus["failures"],
+            "blind_selftest_detects_disabled_evidence": blind_ok,
+            "seconds": seconds,
+        },
+    }
+
+
 def run_gossip(
     n_peers: int = 4,
     p_count: int = 8,
@@ -2812,6 +2875,7 @@ if __name__ == "__main__":
         "fleet": lambda: run_fleet(smoke=fleet_smoke),
         "catchup": lambda: run_catchup(smoke=fleet_smoke),
         "gossip": lambda: run_gossip(smoke=fleet_smoke),
+        "chaos": lambda: run_chaos(smoke=fleet_smoke),
         "default": run_default,
     }
     def _registry_snapshot() -> dict:

@@ -8,12 +8,15 @@ any language reproduces exactly these byte sequences.
 from __future__ import annotations
 
 import json
+import random
 import socket
 import threading
+import time
 from concurrent.futures import Future
 from dataclasses import dataclass
 
 from ..errors import StatusCode, error_for_code
+from ..obs import flight_recorder
 from ..obs.trace import TraceContext, current_context
 from . import protocol as P
 
@@ -36,6 +39,38 @@ class BridgeConnectionLost(ConnectionError):
     transport's channels) resolves to this — a typed, per-request signal
     that the response will never arrive, distinct from a server-side
     rejection (:class:`BridgeError`)."""
+
+
+@dataclass(frozen=True)
+class ReconnectPolicy:
+    """Bounded, jittered exponential backoff for opt-in channel
+    auto-reconnect (:class:`PipelinedBridgeClient` and the gossip
+    :class:`~hashgraph_tpu.gossip.transport.GossipTransport` both take
+    one). The contract is deliberately narrow: in-flight requests on a
+    dying channel STILL fail typed (``BridgeConnectionLost`` — a lost
+    frame cannot be replayed safely by a generic layer), but the channel
+    itself comes back — fresh socket, fresh HELLO feature negotiation —
+    so a crash-restarting peer heals without embedder plumbing. Jitter
+    (a random fraction shaved off each delay) keeps a fleet of clients
+    from stampeding a peer the moment it returns."""
+
+    max_attempts: int = 6
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    jitter: float = 0.5  # fraction of each delay randomized away
+
+    def __post_init__(self):
+        if self.max_attempts <= 0:
+            raise ValueError("max_attempts must be positive")
+        if not (0.0 <= self.jitter <= 1.0):
+            raise ValueError("jitter must be in [0, 1]")
+
+    def delay(self, attempt: int, rng=random) -> float:
+        """Backoff before attempt ``attempt`` (0-based): exponential from
+        ``base_delay``, capped at ``max_delay``, minus a random slice up
+        to ``jitter`` of itself."""
+        full = min(self.max_delay, self.base_delay * (2.0 ** attempt))
+        return full * (1.0 - self.jitter * rng.random())
 
 
 @dataclass(frozen=True)
@@ -487,6 +522,16 @@ class PipelinedBridgeClient:
     If the connection drops with requests in flight, every pending
     future raises :class:`BridgeConnectionLost`.
 
+    ``reconnect`` (a :class:`ReconnectPolicy`; default None = the old
+    stay-dead behavior) opts into auto-reconnect: when the connection
+    dies, pending futures still fail typed, but a background thread
+    re-dials with capped, jittered exponential backoff and re-runs the
+    HELLO negotiation, after which new submits flow again — the healing
+    a crash-restarting server needs without embedder plumbing. Submits
+    issued while the channel is down fail fast with
+    :class:`BridgeConnectionLost` (callers retry; nothing queues against
+    a dead peer).
+
     Not thread-safe for concurrent submitters by design EXCEPT
     :meth:`submit`/the async helpers, which take the writer lock; the
     sync convenience wrappers just await their own future.
@@ -500,62 +545,143 @@ class PipelinedBridgeClient:
         *,
         max_inflight: int = 256,
         features: int = P.SUPPORTED_FEATURES,
+        reconnect: "ReconnectPolicy | None" = None,
     ):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        P.tune_socket(self._sock)
+        self._host = host
+        self._port = port
         self._timeout = timeout
-        self._closed = False
+        self._offered = features
+        self._reconnect = reconnect
+        self._shutdown = False  # user called close(); never resurrect
+        self._closed = True
         self._features = 0
-        # HELLO handshake runs in the plain one-frame framing; only a
-        # granted pipelining bit switches the connection.
-        self._sock.sendall(
-            P.encode_frame(
-                P.OP_HELLO, P.u32(P.PROTOCOL_VERSION) + P.u32(features)
-            )
-        )
-        status, cursor = P.read_frame(self._sock)
-        if status == P.STATUS_OK:
-            cursor.u32()  # server protocol version
-            self._features = cursor.u32()
-        elif status != P.STATUS_UNKNOWN_OPCODE:
-            message = ""
-            try:
-                message = cursor.string()
-            except ValueError:
-                pass
-            self._sock.close()
-            raise BridgeError(status, message)
-        self.pipelined = bool(self._features & P.FEATURE_PIPELINING)
         self._write_lock = threading.Lock()
         self._pending: dict[int, Future] = {}
         self._pending_lock = threading.Lock()
         self._next_corr = 0
+        # ONE window for the client's lifetime: credits released by the
+        # old connection's cleanup must be the same tokens new submits
+        # acquire, or a reconnect could over-release the semaphore.
         self._window = threading.BoundedSemaphore(max_inflight)
         self._reader: threading.Thread | None = None
-        if self.pipelined:
-            # The reader blocks in recv for the connection's lifetime;
-            # close() unblocks it by shutting the socket down.
-            self._sock.settimeout(None)
-            self._reader = threading.Thread(
-                target=self._read_loop, daemon=True,
-                name="bridge-pipelined-reader",
+        self._reconnector: threading.Thread | None = None
+        self._establish()
+
+    def _establish(self) -> None:
+        """Dial + HELLO + (when granted) start the reader — the shared
+        path of the constructor and every reconnect attempt."""
+        sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
+        )
+        P.tune_socket(sock)
+        features = 0
+        try:
+            # HELLO handshake runs in the plain one-frame framing; only a
+            # granted pipelining bit switches the connection.
+            sock.sendall(
+                P.encode_frame(
+                    P.OP_HELLO,
+                    P.u32(P.PROTOCOL_VERSION) + P.u32(self._offered),
+                )
             )
-            self._reader.start()
+            status, cursor = P.read_frame(sock)
+            if status == P.STATUS_OK:
+                cursor.u32()  # server protocol version
+                features = cursor.u32()
+            elif status != P.STATUS_UNKNOWN_OPCODE:
+                message = ""
+                try:
+                    message = cursor.string()
+                except ValueError:
+                    pass
+                raise BridgeError(status, message)
+        except BaseException:
+            sock.close()
+            raise
+        with self._pending_lock:
+            # A close() racing a reconnect attempt must not be undone by
+            # a late _establish: once shutdown is set, refuse the fresh
+            # socket instead of resurrecting the client.
+            if self._shutdown:
+                sock.close()
+                raise BridgeConnectionLost("client closed during reconnect")
+            self._sock = sock
+            self._features = features
+            self.pipelined = bool(features & P.FEATURE_PIPELINING)
+            if self.pipelined:
+                # The reader blocks in recv for the connection's
+                # lifetime; close() unblocks it by shutting the socket
+                # down.
+                self._sock.settimeout(None)
+                self._reader = threading.Thread(
+                    target=self._read_loop, daemon=True,
+                    name="bridge-pipelined-reader",
+                )
+                self._reader.start()
+            # Open for submits only once the connection is fully set up.
+            self._closed = False
 
     @property
     def features(self) -> int:
         """Feature bits the server granted (0 against an old server)."""
         return self._features
 
+    def _spawn_reconnector(self) -> None:
+        """Start (at most one) background reconnect loop, if opted in and
+        the death was not a user close()."""
+        if self._reconnect is None or self._shutdown:
+            return
+        with self._pending_lock:
+            if self._reconnector is not None and self._reconnector.is_alive():
+                return
+            thread = threading.Thread(
+                target=self._reconnect_loop, daemon=True,
+                name="bridge-reconnector",
+            )
+            self._reconnector = thread
+        thread.start()
+
+    def _reconnect_loop(self) -> None:
+        policy = self._reconnect
+        for attempt in range(policy.max_attempts):
+            time.sleep(policy.delay(attempt))
+            if self._shutdown:
+                return
+            try:
+                self._establish()
+            except (ConnectionError, OSError, BridgeError):
+                continue
+            flight_recorder.record(
+                "bridge.reconnected",
+                host=self._host, port=self._port, attempt=attempt + 1,
+            )
+            return
+        flight_recorder.record(
+            "bridge.reconnect_failed",
+            host=self._host, port=self._port, attempts=policy.max_attempts,
+        )
+
     def close(self) -> None:
+        self._shutdown = True
         self._closed = True
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        self._sock.close()
-        if self._reader is not None:
-            self._reader.join(timeout=5)
+        # Two sweeps: the first closes the current socket and waits out
+        # the reconnector; a reconnect attempt that raced the shutdown
+        # flag may have installed a fresh socket/reader in between, so
+        # the second sweep (after the reconnector is provably done —
+        # _establish refuses once _shutdown is set) closes that one too.
+        for _ in range(2):
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            if self._reader is not None:
+                self._reader.join(timeout=5)
+            if self._reconnector is not None:
+                self._reconnector.join(timeout=5)
 
     def __enter__(self) -> "PipelinedBridgeClient":
         return self
@@ -573,14 +699,21 @@ class PipelinedBridgeClient:
         future is already resolved."""
         future: Future = Future()
         if not self.pipelined:
+            if self._closed:
+                future.set_exception(
+                    BridgeConnectionLost("bridge connection is down")
+                )
+                return future
             try:
                 with self._write_lock:
                     self._sock.sendall(P.encode_frame(opcode, payload))
                     status, cursor = P.read_frame(self._sock)
             except (ConnectionError, OSError) as exc:
+                self._closed = True
                 future.set_exception(
                     BridgeConnectionLost(f"bridge connection lost: {exc}")
                 )
+                self._spawn_reconnector()
                 return future
             if status == P.STATUS_OK:
                 future.set_result(cursor)
@@ -644,6 +777,7 @@ class PipelinedBridgeClient:
             for future in pending:
                 self._window.release()
                 future.set_exception(lost)
+            self._spawn_reconnector()
 
     def call(self, opcode: int, payload: bytes = b"") -> P.Cursor:
         """Blocking :meth:`submit` (one round trip in either mode)."""

@@ -370,6 +370,36 @@ class BridgeServer:
             out["reasons"] = reasons
         return out
 
+    def start_embedded(self) -> None:
+        """Serve frames in-process through :meth:`dispatch_frame` without
+        binding a listener or starting any thread. Same dispatch table,
+        same per-peer engines, same WAL/recovery machinery as the TCP
+        front-end — this is the deterministic cluster simulator's mode
+        (:mod:`hashgraph_tpu.sim`): every byte still crosses the wire
+        codec and the live validation paths, but scheduling is entirely
+        the caller's, so a run can be a pure function of its seed.
+        ``stop()`` quiesces an embedded server exactly as a started one
+        (durable peer WALs flushed and closed, peers evicted)."""
+        if self._running:
+            raise RuntimeError("server already started")
+        self._running = True
+
+    def dispatch_frame(self, opcode: int, payload: bytes = b"") -> tuple[int, bytes]:
+        """Dispatch ONE decoded frame (opcode + payload bytes) through
+        the live handler table and return ``(status, response payload)``
+        — the socketless request/response unit the embedded mode serves.
+        The wire's error contract applies (ConsensusError -> status code,
+        malformed payloads -> STATUS_BAD_REQUEST), identical to what a
+        TCP client would read back."""
+        if not self._running:
+            raise RuntimeError("server not started")
+        self._m_requests.inc()
+        flight_recorder.record("bridge.op", opcode=opcode)
+        status, out = self._safe_dispatch(opcode, P.Cursor(payload))
+        if status >= P.STATUS_UNKNOWN_PEER:
+            self._m_errors.inc()
+        return status, out
+
     def start(self) -> tuple[str, int]:
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

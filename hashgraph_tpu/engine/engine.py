@@ -1340,17 +1340,35 @@ class TpuConsensusEngine(Generic[Scope]):
         # extension would have taken the watermark path; a shorter/equal
         # chain with an agreeing prefix matched its tail above — its
         # differing vote at any position, tail included, IS a divergent
-        # history): find the fork position, retain the signed pair.
+        # history). Conviction bar (chaos-harness refinement, PARITY.md):
+        # a positional divergence alone is NOT evidence against the
+        # divergent vote's signer — an honest vote can land at a
+        # different position under loss/reorder (or a racing embedder),
+        # and grading its signer suspect would defame an honest peer.
+        # Fork evidence is retained only when the divergent vote's owner
+        # ALSO has a different accepted vote in this session — two
+        # validly-shaped distinct votes by one signer, the same
+        # self-authenticating double-sign bar the equivocation probe
+        # applies. Anything weaker is counted, not convicted.
         for ours, theirs in zip(accepted, incoming):
             if ours.vote_hash != theirs.vote_hash:
-                self.health.note_fork(
-                    record.scope,
-                    proposal.proposal_id,
-                    ours.encode(),
-                    theirs.encode(),
-                    theirs.vote_owner,
-                    now,
-                )
+                prior = record.votes.get(theirs.vote_owner)
+                if prior is None and record.session is not None:
+                    prior = record.session.votes.get(theirs.vote_owner)
+                if prior is not None and prior.vote_hash != theirs.vote_hash:
+                    # The retained pair is (offender's accepted vote,
+                    # offender's divergent vote) — both carry the
+                    # offender's signature, verifiable offline.
+                    self.health.note_fork(
+                        record.scope,
+                        proposal.proposal_id,
+                        prior.encode(),
+                        theirs.encode(),
+                        theirs.vote_owner,
+                        now,
+                    )
+                else:
+                    self.tracer.count("engine.divergent_redeliveries")
                 return
 
     def _extension_suffix(
@@ -1867,6 +1885,13 @@ class TpuConsensusEngine(Generic[Scope]):
         # health), so the hot path pays dict stores, not per-vote locks.
         admit_counts: dict[bytes, int] = {}
         admit_timeout = 0.0
+        # Chain-linkage tails per record for THIS batch: a same-batch
+        # chained run (v2 extends tail, v3 extends v2) must see v2 as the
+        # effective tail even on the device substrate, whose host-side
+        # append happens after the dispatch. Optimistic — a mid-batch
+        # apply-stage rejection (round cap) can let one dangling
+        # follower through, matching the pre-guard behavior there.
+        pending_tail: dict[int, bytes] = {}
 
         # Batched signature verification: one scheme call for the whole batch
         # (native runtime: one pool-fanned C batch, GIL-free). Verdicts are
@@ -1918,6 +1943,45 @@ class TpuConsensusEngine(Generic[Scope]):
                     statuses[i] = int(exc.code)
                     self._note_reject_health(vote, int(exc.code), now)
                     continue
+            # Dangling-vote guard (chaos-harness hardening, PARITY.md): a
+            # FIRST-TIME voter whose received_hash names a vote this
+            # session never accepted is rejected instead of appended. An
+            # appended dangling vote makes the local chain positionally
+            # incomparable to the sender's — the watermark can then never
+            # extend it and anti-entropy can never repair the peer to
+            # byte-identical state (and the divergence used to read as
+            # fork "evidence" against an honest signer). Redeliveries and
+            # equivocations (known owners) keep their duplicate-shaped
+            # statuses; empty links and columnar-retained sessions keep
+            # the reference's permissive behavior.
+            first_time_voter = not record.retained_wire and (
+                vote.vote_owner not in record.votes
+                and (
+                    record.session is None
+                    or (
+                        vote.vote_owner not in record.session.tallies
+                        and vote.vote_owner not in record.session.votes
+                    )
+                )
+            )
+            if first_time_voter:
+                if vote.received_hash:
+                    # An empty chain has no tail: a first vote claiming a
+                    # received link is dangling by definition (the chain
+                    # head always carries an empty link).
+                    tail = pending_tail.get(
+                        slot,
+                        record.proposal.votes[-1].vote_hash
+                        if record.proposal.votes
+                        else b"",
+                    )
+                    if vote.received_hash != tail:
+                        statuses[i] = int(StatusCode.RECEIVED_HASH_MISMATCH)
+                        self.tracer.count("engine.dangling_votes_rejected")
+                        continue
+                # This vote is now the batch-effective tail for the
+                # record (known-owner duplicates never move the tail).
+                pending_tail[slot] = vote.vote_hash
             if record.session is not None:
                 was_active = record.session.state.is_active
                 code, event = self._host_add_vote(record, vote, now)

@@ -92,9 +92,16 @@ class GossipNode:
         flush_interval: float = 0.005,
         escalate_sessions: int = 64,
         flusher: bool = False,
+        catchup_factory=None,
     ):
         self.name = name
         self._engine = engine
+        # Escalation seam: ``catchup_factory(host, port, peer_id)`` must
+        # return a CatchUpClient-shaped object (catch_up + close). The
+        # default dials a real bridge over TCP; the deterministic
+        # simulator injects one that rides its in-process fabric instead,
+        # so the far-behind escalation path itself stays the live code.
+        self._catchup_factory = catchup_factory
         self._transport = transport if transport is not None else GossipTransport()
         self._owns_transport = transport is None
         self._fanout = fanout
@@ -494,9 +501,13 @@ class GossipNode:
             return None  # undurable / unreachable: incremental repair only
         if manifest["session_count"] < self._escalate_sessions:
             return None
-        from ..sync import CatchUpClient
+        if self._catchup_factory is not None:
+            client_factory = self._catchup_factory
+        else:
+            from ..sync import CatchUpClient
 
-        with CatchUpClient(info.host, info.port, info.peer_id) as client:
+            client_factory = CatchUpClient
+        with client_factory(info.host, info.port, info.peer_id) as client:
             catchup = client.catch_up(self._engine)
         self._m_escalations.inc()
         flight_recorder.record(

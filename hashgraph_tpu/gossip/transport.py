@@ -31,12 +31,13 @@ from __future__ import annotations
 import selectors
 import socket
 import threading
+import time
 import weakref
 from collections import deque
 from concurrent.futures import Future
 
 from ..bridge import protocol as P
-from ..bridge.client import BridgeConnectionLost, BridgeError
+from ..bridge.client import BridgeConnectionLost, BridgeError, ReconnectPolicy
 from ..obs import (
     GOSSIP_FRAMES_SENT_TOTAL,
     GOSSIP_FRAMES_SHED_TOTAL,
@@ -131,6 +132,7 @@ class GossipTransport:
         features: int = P.SUPPORTED_FEATURES,
         sndbuf: int | None = None,
         rcvbuf: int | None = None,
+        reconnect: "ReconnectPolicy | None" = None,
     ):
         self._max_inflight = max_inflight
         self._max_queue_bytes = max_queue_bytes
@@ -138,6 +140,14 @@ class GossipTransport:
         self._features = features
         self._sndbuf = sndbuf
         self._rcvbuf = rcvbuf
+        # Opt-in channel healing: when a peer's channel dies (and the
+        # transport itself is not closing), re-dial it with capped
+        # jittered backoff and a fresh HELLO. In-flight and queued
+        # futures on the dead channel still fail typed — only the
+        # CHANNEL heals; lost frames are the anti-entropy layer's job.
+        self._reconnect = reconnect
+        self._endpoints: dict[str, tuple[str, int]] = {}
+        self._reconnecting: set[str] = set()
         self._sel = selectors.DefaultSelector()
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
@@ -228,12 +238,21 @@ class GossipTransport:
             name, sock, features, self._max_inflight, self._max_queue_bytes
         )
         with self._lock:
+            # Re-checked at registration time: a reconnect attempt's
+            # blocking dial can race close() past the entry check, and a
+            # channel registered after the loop thread exited would
+            # never be serviced — its futures would hang instead of
+            # failing typed.
+            if not self._running:
+                sock.close()
+                raise RuntimeError("transport is closed")
             old = self._channels.get(name)
             if old is not None and old.alive:
                 sock.close()
                 raise ValueError(f"peer {name!r} already connected")
             self._channels[name] = channel
             self._pending_register.append(channel)
+            self._endpoints[name] = (host, port)
         self._wake()
         return channel
 
@@ -469,6 +488,47 @@ class GossipTransport:
         for future in pending + queued:
             if not future.done():
                 future.set_exception(error)
+        if record and self._running:
+            self._maybe_reconnect(channel.name)
+
+    def _maybe_reconnect(self, name: str) -> None:
+        """Spawn (at most one per peer) the bounded backoff re-dial loop,
+        when the transport opted into a :class:`ReconnectPolicy`."""
+        if self._reconnect is None:
+            return
+        with self._lock:
+            endpoint = self._endpoints.get(name)
+            if endpoint is None or name in self._reconnecting:
+                return
+            self._reconnecting.add(name)
+        threading.Thread(
+            target=self._reconnect_loop, args=(name, *endpoint),
+            daemon=True, name=f"gossip-reconnect-{name}",
+        ).start()
+
+    def _reconnect_loop(self, name: str, host: str, port: int) -> None:
+        policy = self._reconnect
+        try:
+            for attempt in range(policy.max_attempts):
+                time.sleep(policy.delay(attempt))
+                if not self._running:
+                    return
+                try:
+                    self.connect(name, host, port)
+                except (ConnectionError, OSError, BridgeError, ValueError,
+                        RuntimeError):
+                    continue
+                flight_recorder.record(
+                    "gossip.reconnected", peer=name, attempt=attempt + 1,
+                )
+                return
+            flight_recorder.record(
+                "gossip.reconnect_failed", peer=name,
+                attempts=policy.max_attempts,
+            )
+        finally:
+            with self._lock:
+                self._reconnecting.discard(name)
 
 
 class ChannelBusy(RuntimeError):

@@ -16,7 +16,10 @@ redeliveries, equivocations — into an accountable health layer:
 - :class:`EvidenceRecord` — when two validly-signed conflicting votes
   from one peer are observed (same scope/proposal, different value or
   chain position), or a redelivered chain forks before the validated
-  watermark, the signed byte pairs are retained instead of dropped.
+  watermark at a position whose divergent vote's signer also has a
+  different accepted vote (the double-sign bar — positional divergence
+  alone is honestly producible and never attributed), the signed byte
+  pairs are retained instead of dropped.
   Evidence is *self-authenticating*: both sides carry the offender's own
   signature over their content, so any third party can verify the
   conflict offline without trusting this process (the BFT-accountability
@@ -479,10 +482,16 @@ class HealthMonitor:
         now: int,
     ) -> None:
         """A redelivered chain diverging from the accepted prefix before
-        the validated watermark. The conflicting vote's signature was NOT
-        verified here (the watermark path settles forks crypto-free —
-        PR 4's whole point); the retained byte pair is self-authenticating
-        for offline audit, so the record is marked ``verified=False``."""
+        the validated watermark, where the divergent vote's owner ALSO
+        has a different accepted vote in the session — the engine only
+        reports forks that meet the double-sign bar, so
+        ``accepted_vote_bytes``/``conflicting_vote_bytes`` are BOTH the
+        offender's own signed votes (a positional divergence alone can be
+        produced by honest loss/reorder and is never attributed). The
+        conflicting vote's signature was NOT verified here (the watermark
+        path settles forks crypto-free — PR 4's whole point); the
+        retained byte pair is self-authenticating for offline audit, so
+        the record is marked ``verified=False``."""
         record = EvidenceRecord(
             kind=KIND_FORK,
             offender=offender,
@@ -578,6 +587,34 @@ class HealthMonitor:
     def evidence(self) -> "list[dict]":
         with self._lock:
             return [record.as_dict() for record in self._evidence]
+
+    def convicted_peers(
+        self, now: int | None = None, min_grade: str = GRADE_SUSPECT
+    ) -> "dict[str, dict]":
+        """Peers this monitor currently grades at or past ``min_grade``
+        (default: every non-healthy peer) — the accountability readout
+        the chaos harness asserts against. Returns ``identity-hex ->
+        {"grade", "evidence"}`` where ``evidence`` counts the retained
+        records naming that peer as offender. A conviction is only as
+        good as its evidence: ``faulty`` grades always carry verified
+        self-authenticating records; ``suspect`` grades may rest on
+        circumstantial counters (invalid signatures, forked or stale
+        redeliveries) an operator weighs rather than slashing on."""
+        rank = _GRADE_RANK[min_grade]
+        with self._lock:
+            tick = self.latest_now if now is None else now
+            offenders: dict[bytes, int] = {}
+            for record in self._evidence:
+                offenders[record.offender] = offenders.get(record.offender, 0) + 1
+            out: dict[str, dict] = {}
+            for identity, card in self._peers.items():
+                grade = card.grade(tick, self.stale_after)
+                if _GRADE_RANK[grade] >= rank:
+                    out[identity.hex()] = {
+                        "grade": grade,
+                        "evidence": offenders.get(identity, 0),
+                    }
+            return out
 
     def watchdog(self, now: int | None = None) -> "list[str]":
         """Identity hexes of peers silent past their staleness threshold
@@ -699,6 +736,15 @@ class HealthMonitor:
         return {
             "now": view["now"],
             "peers": view["peers"],
+            # Accountability digest: every peer graded past healthy in
+            # THIS report (same view as the scorecards beside it). The
+            # chaos harness's conviction asserts read this key; see
+            # convicted_peers() for the evidence-weighted readout.
+            "convicted": {
+                hexid: card["grade"]
+                for hexid, card in view["peers"].items()
+                if card["grade"] != GRADE_HEALTHY
+            },
             "evidence": view["evidence"],
             "watchdog": {
                 "stale_peers": view["stale"],

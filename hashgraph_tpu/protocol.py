@@ -48,8 +48,30 @@ def fold_u128_to_u32(n: int) -> int:
     return ((n >> 96) ^ (n >> 64) ^ (n >> 32) ^ n) & _U32_MASK
 
 
+# Entropy seam for deterministic simulation: when set, generate_id draws
+# its 128-bit value from this callable instead of uuid4. The seeded
+# cluster simulator (hashgraph_tpu.sim) installs a scenario-rng source so
+# every minted proposal/vote id — and therefore every signed byte and
+# state fingerprint — is a pure function of the scenario seed. Production
+# and tests leave it None (uuid4, the reference's behavior).
+_id_entropy = None
+
+
+def set_id_entropy(source) -> None:
+    """Install (or with ``None`` remove) a ``() -> int`` 128-bit entropy
+    source backing :func:`generate_id`. Simulation-only seam; not
+    thread-scoped — callers own the install/restore discipline."""
+    global _id_entropy
+    _id_entropy = source
+
+
 def generate_id() -> int:
-    """Generate a unique 32-bit ID from a UUIDv4 (reference: src/utils.rs:27-30)."""
+    """Generate a unique 32-bit ID from a UUIDv4 (reference: src/utils.rs:27-30).
+
+    Under :func:`set_id_entropy` the 128 bits come from the installed
+    source instead, making id minting deterministic per scenario seed."""
+    if _id_entropy is not None:
+        return fold_u128_to_u32(_id_entropy() & ((1 << 128) - 1))
     return fold_u128_to_u32(uuid.uuid4().int)
 
 

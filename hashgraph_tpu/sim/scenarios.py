@@ -1,0 +1,391 @@
+"""The chaos scenario corpus: composed, reproducible adversity.
+
+Every scenario drives a :class:`~hashgraph_tpu.sim.cluster.SimCluster`
+through real traffic while injecting one family of faults, then hands
+the cluster to the three machine-checked verdicts
+(:mod:`hashgraph_tpu.sim.verdicts`): convergence, exact-culprit
+accountability, honest-decision safety. ``run_scenario(name, seed)`` is
+a pure function of its arguments — same seed, byte-identical verdict
+JSON — which is what makes the corpus a regression harness rather than
+a demo: `bench.py chaos` and `make chaos-smoke` run it at pinned seeds,
+and any future PR that breaks a failure path breaks a deterministic
+assert, not a flake.
+
+The corpus (≥ the ISSUE's eight):
+
+- ``partition-heal``        — symmetric split, per-side progress, heal
+- ``asymmetric-partition``  — requests deliver, responses die (one-way)
+- ``storm``                 — drop + duplicate + reorder on every link
+- ``crash-restart-wal``     — kill -9 mid-append (torn tail), WAL recovery
+- ``crash-restart-catchup`` — disk loss, snapshot+tail catch-up escalation
+- ``equivocator``           — signed double-voting, faulty + verified evidence
+- ``forker``                — divergent chain delivery, fork evidence
+- ``expired-spam-burst``    — expired gossip + in-flight signature corruption
+- ``timeout-liveness``      — embedder timeouts decide identically everywhere
+
+A corpus run can also prove the harness is not blind to itself:
+``blind=True`` disables the health/evidence layer (the deliberately
+broken injector-run of the acceptance criteria) and the accountability
+verdict MUST fail.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+
+from ..obs.health import GRADE_FAULTY, GRADE_SUSPECT
+from ..wal import scan
+from .byzantine import ByzantineActor
+from .cluster import SimCluster
+from .verdicts import (
+    accountability_verdict,
+    convergence_verdict,
+    safety_verdict,
+)
+
+
+def _blind(cluster: SimCluster) -> None:
+    """The deliberately-broken run: replay mode pauses every engine's
+    health accounting, so injected misbehavior leaves no scorecard or
+    evidence trail — the accountability verdict must catch the silence."""
+    for peer in cluster.peers:
+        peer.engine.set_replay_mode(True)
+
+
+def _finish(
+    cluster: SimCluster,
+    culprits: "dict[str, str]",
+    checks: "dict[str, bool] | None" = None,
+    detail: "dict | None" = None,
+) -> dict:
+    traffic = cluster.drain_all()
+    convergence = convergence_verdict(cluster)
+    accountability = accountability_verdict(cluster, culprits)
+    safety = safety_verdict(cluster)
+    checks = dict(checks or {})
+    passed = (
+        convergence["ok"]
+        and accountability["ok"]
+        and safety["ok"]
+        and all(checks.values())
+    )
+    return {
+        "passed": passed,
+        "verdicts": {
+            "convergence": convergence,
+            "accountability": accountability,
+            "safety": safety,
+        },
+        "checks": checks,
+        "network": cluster.network.stats.as_dict(),
+        "traffic": traffic,
+        "detail": dict(detail or {}),
+    }
+
+
+# ── scenario bodies: (cluster) -> (culprits, checks, detail) ───────────
+
+
+def _partition_heal(c: SimCluster):
+    pre = c.create_session(c.peer(0), "pre")
+    c.vote_all(pre)
+    c.network.partition(["p0", "p1"], ["p2", "p3"])
+    left = c.create_session(c.peer(0), "left")
+    for i in (0, 1):
+        c.cast_vote(left, c.peer(i), True)
+    right = c.create_session(c.peer(2), "right")
+    for i in (2, 3):
+        c.cast_vote(right, c.peer(i), True)
+    blocked_mid = c.network.stats.blocked
+    c.network.heal_partition()
+    c.anti_entropy_round()
+    for i in (2, 3):
+        c.cast_vote(left, c.peer(i), True)
+    for i in (0, 1):
+        c.cast_vote(right, c.peer(i), True)
+    return {}, {"partition_dropped_frames": blocked_mid > 0}, {
+        "blocked_during_partition": blocked_mid
+    }
+
+
+def _asymmetric_partition(c: SimCluster):
+    pre = c.create_session(c.peer(0), "pre")
+    c.vote_all(pre)
+    # One-way: frames FROM p1/p2/p3 TO p0 die. p0's own requests still
+    # EXECUTE on the others — only the answers are lost, so p0 mutates
+    # the world while believing every call failed.
+    c.network.partition(["p1", "p2", "p3"], ["p0"], bidirectional=False)
+    outbound = c.create_session(c.peer(0), "outbound")
+    c.vote_all(outbound)
+    hidden = c.create_session(c.peer(1), "hidden")
+    for i in (1, 2, 3):
+        c.cast_vote(hidden, c.peer(i), True)
+    lost_mid = c.network.stats.response_lost + c.network.stats.blocked
+    c.network.heal_partition()
+    c.anti_entropy_round()
+    for session in (outbound, hidden):
+        c.vote_all(session)
+    return {}, {"asymmetric_loss_observed": lost_mid > 0}, {
+        "lost_during_partition": lost_mid
+    }
+
+
+def _storm(c: SimCluster):
+    names = [p.name for p in c.peers]
+    pre = c.create_session(c.peer(0), "pre")
+    c.vote_all(pre)
+    c.network.set_all_links(names, drop_p=0.2, dup_p=0.25, jitter=3)
+    for k in range(3):
+        session = c.create_session(c.peer(k % len(c.peers)), f"storm-{k}")
+        c.vote_all(session, values=[True, True, True, False])
+    stats = c.network.stats
+    dropped, duplicated = stats.dropped, stats.duplicated
+    c.network.clear_faults()
+    for session in c.sessions:
+        c.vote_all(session)  # finish the turns the storm ate
+    return {}, {
+        "storm_dropped_frames": dropped > 0,
+        "storm_duplicated_frames": duplicated > 0,
+    }, {"dropped": dropped, "duplicated": duplicated}
+
+
+def _crash_restart_wal(c: SimCluster):
+    pre = c.create_session(c.peer(0), "pre")
+    c.vote_all(pre)
+    victim = c.peer(1)
+    target = c.create_session(c.peer(0), "crashy")
+    for i in (0, 2):
+        c.cast_vote(target, c.peer(i), True)
+    # kill -9 mid-append: the victim's own vote tears on disk.
+    wal_directory = victim.durable.wal.directory
+    victim.crash_mid_append(target, torn_bytes=9)
+    torn = scan(wal_directory).torn_bytes
+    while_down = c.create_session(c.peer(2), "while-down")
+    c.vote_all(while_down)
+    victim.restart()
+    recovery = victim.last_recovery
+    c.cast_vote(target, victim, True)
+    c.cast_vote(while_down, victim, True)
+    return {}, {
+        "torn_write_on_disk": torn > 0,
+        "recovery_replayed_records": recovery.records_applied > 0,
+        "recovery_clean": not recovery.errors
+        and recovery.segments_dropped == 0,
+    }, {
+        "torn_bytes": torn,
+        "records_replayed": recovery.records_applied,
+        "votes_replayed": recovery.votes_replayed,
+    }
+
+
+def _crash_restart_catchup(c: SimCluster):
+    for k in range(5):
+        session = c.create_session(c.peer(k % 3), f"hist-{k}")
+        c.vote_all(session)
+    victim = c.peer(3)
+    victim.crash()
+    while_down = c.create_session(c.peer(0), "while-down")
+    c.vote_all(while_down)
+    victim.restart(wipe=True)  # the disk is gone: rejoin as a fresh peer
+    # The fresh node's first repair round must escalate to a full
+    # snapshot+tail catch-up (CatchUpClient over the sim fabric) instead
+    # of absorbing the history as thousands of deliver frames.
+    victim.node.anti_entropy(c.now)
+    c.run_network()
+    occupancy = victim.engine.occupancy()
+    return {}, {
+        "catchup_escalated": c.catchups >= 1,
+        "sessions_installed": occupancy.get("live_sessions", 0) >= 5,
+    }, {
+        "catchups": c.catchups,
+        "sessions_after_catchup": occupancy.get("live_sessions", 0),
+    }
+
+
+def _equivocator(c: SimCluster):
+    byz = ByzantineActor(c)
+    pre = c.create_session(c.peer(0), "pre")
+    c.vote_all(pre)
+    target = c.create_session(c.peer(0), "target")
+    c.cast_vote(target, c.peer(0), True)
+    byz.equivocate(target)
+    for i in (1, 2):
+        c.cast_vote(target, c.peer(i), True)
+    culprit = byz.identity.hex()
+    alert_everywhere = all(
+        any(
+            alert["rule"] == "peer-faulty"
+            for alert in peer.monitor.evaluate_alerts(now=c.now)
+        )
+        for peer in c.live_peers()
+    )
+    evidence_everywhere = all(
+        peer.monitor.evidence_count() >= 1 for peer in c.live_peers()
+    )
+    return {culprit: GRADE_FAULTY}, {
+        "peer_faulty_alert_everywhere": alert_everywhere,
+        "evidence_everywhere": evidence_everywhere,
+    }, {"culprit": culprit}
+
+
+def _forker(c: SimCluster):
+    byz = ByzantineActor(c)
+    target = c.create_session(c.peer(0), "forked")
+    for i in (0, 1):
+        c.cast_vote(target, c.peer(i), True)
+    byz.join(target)  # the forker's legitimate vote — its fork replaces it
+    c.cast_vote(target, c.peer(2), True)
+    byz.fork_deliver(target)
+    culprit = byz.identity.hex()
+    evidence_everywhere = all(
+        peer.monitor.evidence_count() >= 1 for peer in c.live_peers()
+    )
+    return {culprit: GRADE_SUSPECT}, {
+        "fork_evidence_everywhere": evidence_everywhere,
+    }, {"culprit": culprit}
+
+
+def _expired_spam_burst(c: SimCluster):
+    byz = ByzantineActor(c)
+    live = c.create_session(c.peer(0), "live")
+    for i in (0, 1):
+        c.cast_vote(live, c.peer(i), True)
+    byz.arm_frame_mutation()
+    byz.signature_burst(live, count=5)
+    byz.expired_spam("junk", count=4)
+    culprit = byz.identity.hex()
+    cards = [
+        peer.monitor.scorecard(byz.identity) or {}
+        for peer in c.live_peers()
+    ]
+    burst_alert = all(
+        any(
+            alert["rule"] == "invalid-signature-burst"
+            for alert in peer.monitor.evaluate_alerts(now=c.now)
+        )
+        for peer in c.live_peers()
+    )
+    for i in (2, 3):
+        c.cast_vote(live, c.peer(i), True)
+    return {culprit: GRADE_SUSPECT}, {
+        "invalid_signatures_scored": all(
+            card.get("invalid_signatures", 0) >= 4 for card in cards
+        ),
+        "expired_gossip_scored": all(
+            card.get("expired_gossip", 0) >= 1 for card in cards
+        ),
+        "signature_burst_alert": burst_alert,
+        "frames_mutated": c.network.stats.mutated > 0,
+    }, {"culprit": culprit, "mutated_frames": c.network.stats.mutated}
+
+
+def _timeout_liveness(c: SimCluster):
+    # expected_voters past the live peer count: the session can only
+    # decide through the embedder's timeout duty.
+    target = c.create_session(c.peer(0), "needs-timeout", voters=8)
+    c.vote_all(target)
+    c.converge()  # every peer must time out on the same view
+    fired = c.fire_timeout(target)
+    results = c.results(target)
+    decided = {
+        name: value for name, value in results.items()
+        if isinstance(value, bool)
+    }
+    return {}, {
+        "every_peer_decided_at_timeout": len(decided) == len(c.live_peers()),
+        "timeout_decisions_agree": len(set(decided.values())) <= 1,
+    }, {"fired": fired, "results_after_timeout": {
+        k: results[k] for k in sorted(results)
+    }}
+
+
+class _Spec:
+    __slots__ = ("body", "cluster_kwargs")
+
+    def __init__(self, body, **cluster_kwargs):
+        self.body = body
+        self.cluster_kwargs = cluster_kwargs
+
+
+SCENARIOS: "dict[str, _Spec]" = {
+    # fanout=2: the sticky per-session sampled fan-out path — peers
+    # outside a session's sample miss it wholly and anti-entropy must
+    # create it wholesale (the repairable-by-design divergence).
+    "partition-heal": _Spec(_partition_heal, fanout=2),
+    "asymmetric-partition": _Spec(_asymmetric_partition),
+    "storm": _Spec(_storm),
+    "crash-restart-wal": _Spec(_crash_restart_wal),
+    "crash-restart-catchup": _Spec(_crash_restart_catchup, escalate_sessions=4),
+    "equivocator": _Spec(_equivocator),
+    "forker": _Spec(_forker),
+    "expired-spam-burst": _Spec(_expired_spam_burst),
+    "timeout-liveness": _Spec(_timeout_liveness),
+}
+
+
+def run_scenario(
+    name: str, seed: int, *, root: "str | None" = None, blind: bool = False
+) -> dict:
+    """One scenario at one seed -> the verdict JSON (a dict; serialize
+    with ``sort_keys=True`` for the byte-identical determinism check).
+    ``blind=True`` disables the health/evidence layer first — the
+    harness's self-test that a broken injector run FAILS."""
+    spec = SCENARIOS[name]
+    owns_root = root is None
+    if owns_root:
+        root = tempfile.mkdtemp(prefix=f"hashgraph-chaos-{name}-")
+    try:
+        with SimCluster(root, seed, **spec.cluster_kwargs) as cluster:
+            if blind:
+                _blind(cluster)
+            culprits, checks, detail = spec.body(cluster)
+            result = _finish(cluster, culprits, checks, detail)
+    finally:
+        if owns_root:
+            shutil.rmtree(root, ignore_errors=True)
+    result["scenario"] = name
+    result["seed"] = seed
+    result["blind"] = blind
+    return result
+
+
+def run_corpus(
+    seeds: "list[int]",
+    names: "list[str] | None" = None,
+    *,
+    blind: bool = False,
+) -> dict:
+    """The whole corpus × seeds -> the machine-readable summary block
+    ``bench.py chaos`` emits: {scenarios: {passed, failed, seeds},
+    results, failures}."""
+    names = list(SCENARIOS) if names is None else list(names)
+    results: dict[str, dict] = {}
+    failures: list[dict] = []
+    passed = failed = 0
+    for name in names:
+        per_seed = {}
+        for seed in seeds:
+            outcome = run_scenario(name, seed, blind=blind)
+            per_seed[str(seed)] = outcome["passed"]
+            if outcome["passed"]:
+                passed += 1
+            else:
+                failed += 1
+                failures.append(
+                    {
+                        "scenario": name,
+                        "seed": seed,
+                        "verdicts": {
+                            key: verdict["ok"]
+                            for key, verdict in outcome["verdicts"].items()
+                        },
+                        "checks": outcome["checks"],
+                    }
+                )
+        results[name] = per_seed
+    return {
+        "scenarios": {"passed": passed, "failed": failed, "seeds": seeds},
+        "results": results,
+        "failures": failures,
+    }

@@ -24,6 +24,7 @@ from .errors import (
     SnapshotDigestError,
     SyncError,
     SyncStateError,
+    SyncTimeoutError,
     SyncVerificationError,
     TailGapError,
     TailRecordError,
@@ -46,6 +47,7 @@ __all__ = [
     "SnapshotManifest",
     "SyncError",
     "SyncStateError",
+    "SyncTimeoutError",
     "SyncVerificationError",
     "TailGapError",
     "TailRecordError",

@@ -64,6 +64,7 @@ from ..wal.recovery import ReplayStats, apply_record
 from .errors import (
     SnapshotDigestError,
     SyncStateError,
+    SyncTimeoutError,
     SyncVerificationError,
     TailGapError,
     TailRecordError,
@@ -193,6 +194,18 @@ class CatchUpClient:
     ``state`` (default: fresh) carries resumable progress — see
     :class:`CatchUpState`. The client owns its bridge connection; close it
     (or use as a context manager) when done.
+
+    ``timeout`` is the wall-clock bound on EVERY network operation
+    (manifest, chunk, tail request): a source that stalls mid-transfer
+    raises the typed :class:`SyncTimeoutError` instead of hanging the
+    joiner thread on a silent socket forever — verified progress stays
+    in ``state`` for a resume against the same or another source.
+
+    ``bridge`` (advanced) injects the transport: any object with the
+    BridgeClient ``sync_manifest``/``sync_chunk``/``wal_tail``/``close``
+    surface serves — the deterministic simulator routes catch-up over
+    its in-process fabric this way. The client closes whatever bridge it
+    holds, injected or not.
     """
 
     # How many times a stale-snapshot response mid-download triggers a
@@ -208,13 +221,27 @@ class CatchUpClient:
         *,
         timeout: float = 30.0,
         state: CatchUpState | None = None,
+        bridge=None,
     ):
-        self._bridge = BridgeClient(host, port, timeout)
+        self._bridge = bridge if bridge is not None else BridgeClient(
+            host, port, timeout
+        )
+        self._timeout = timeout
         self.source_peer = source_peer
         self.state = state if state is not None else CatchUpState()
         self._m_chunks = default_registry.counter(SYNC_CHUNKS_RECEIVED_TOTAL)
         self._m_tail = default_registry.counter(SYNC_TAIL_RECORDS_TOTAL)
         self._m_seconds = default_registry.histogram(SYNC_CATCHUP_SECONDS)
+
+    def _netop(self, operation: str, call):
+        """Run one network operation under the typed-timeout contract:
+        the socket's wall-clock timeout (set at connect) surfaces as
+        :class:`SyncTimeoutError` naming the stalled step, never a raw
+        ``socket.timeout`` the joiner's supervisor cannot route."""
+        try:
+            return call()
+        except TimeoutError as exc:  # socket.timeout is a subclass
+            raise SyncTimeoutError(operation, self._timeout) from exc
 
     def close(self) -> None:
         self._bridge.close()
@@ -329,8 +356,11 @@ class CatchUpClient:
     def _download_snapshot(self, report: CatchUpReport, max_chunk_bytes: int) -> None:
         st = self.state
         for attempt in range(self._STALE_RETRIES + 1):
-            manifest = self._bridge.sync_manifest(
-                self.source_peer, max_chunk_bytes
+            manifest = self._netop(
+                "manifest request",
+                lambda: self._bridge.sync_manifest(
+                    self.source_peer, max_chunk_bytes
+                ),
             )
             if (
                 st.manifest is not None
@@ -345,8 +375,11 @@ class CatchUpClient:
                 for index in range(manifest["chunk_count"]):
                     if index in st.chunks:
                         continue
-                    data = self._bridge.sync_chunk(
-                        self.source_peer, manifest["snapshot_id"], index
+                    data = self._netop(
+                        f"chunk {index} request",
+                        lambda: self._bridge.sync_chunk(
+                            self.source_peer, manifest["snapshot_id"], index
+                        ),
                     )
                     self._check_chunk(manifest, index, data)
                     st.chunks[index] = data
@@ -432,8 +465,11 @@ class CatchUpClient:
             set_mode(True)
         try:
             while True:
-                records, more = self._bridge.wal_tail(
-                    self.source_peer, st.applied_lsn, tail_max_bytes
+                records, more = self._netop(
+                    "tail request",
+                    lambda: self._bridge.wal_tail(
+                        self.source_peer, st.applied_lsn, tail_max_bytes
+                    ),
                 )
                 for lsn, kind, payload in records:
                     if lsn != st.applied_lsn + 1:

@@ -63,3 +63,22 @@ class SyncStateError(SyncError):
     """The joiner engine is not in a state catch-up supports (e.g. it
     already tracks sessions and no snapshot was installed through this
     catch-up state — a snapshot install must target a fresh engine)."""
+
+
+class SyncTimeoutError(SyncError):
+    """A catch-up network operation (manifest, chunk, or tail request)
+    exceeded the client's wall-clock timeout — the source stalled
+    mid-transfer. Distinct from a dead connection (``ConnectionError``):
+    the socket is up but the peer stopped answering, so a joiner thread
+    must not hang on it forever. Progress already verified stays in the
+    :class:`~hashgraph_tpu.sync.CatchUpState`; hand it to a fresh client
+    (same or different source) to resume."""
+
+    def __init__(self, operation: str, timeout: float):
+        super().__init__(
+            f"state-sync {operation} timed out after {timeout:g}s — the "
+            f"source stalled; resume with the same CatchUpState on a "
+            f"fresh client or pick another source"
+        )
+        self.operation = operation
+        self.timeout = timeout

@@ -37,7 +37,14 @@ the recovery-loss counters ``wal.recover.torn_bytes`` /
 from . import format, recovery, segment
 from .durable import DurableEngine
 from .recovery import ReplayStats, WalScan, replay, scan
-from .writer import FSYNC_ALWAYS, FSYNC_BATCH, FSYNC_OFF, WalWriter
+from .writer import (
+    CRASH_POINTS,
+    FSYNC_ALWAYS,
+    FSYNC_BATCH,
+    FSYNC_OFF,
+    SimulatedCrash,
+    WalWriter,
+)
 
 __all__ = [
     "DurableEngine",
@@ -49,6 +56,8 @@ __all__ = [
     "FSYNC_ALWAYS",
     "FSYNC_BATCH",
     "FSYNC_OFF",
+    "CRASH_POINTS",
+    "SimulatedCrash",
     "format",
     "recovery",
     "segment",

@@ -177,6 +177,14 @@ class DurableEngine:
     def close(self) -> None:
         self._wal.close()
 
+    def abandon(self) -> None:
+        """Simulated ``kill -9`` (see :meth:`WalWriter.abandon`): release
+        the WAL's handles and flock without the close-path fsync, so a
+        chaos harness can restart this identity from the surviving log
+        in-process. The wrapped engine object is left as-is — a crashed
+        process's memory is simply gone; callers drop their reference."""
+        self._wal.abandon()
+
     def __enter__(self) -> "DurableEngine":
         return self
 

@@ -56,6 +56,40 @@ _POLICIES = (FSYNC_ALWAYS, FSYNC_BATCH, FSYNC_OFF)
 # prefix / ``.seg`` suffix), so listing/compaction ignore it.
 LOCK_FILENAME = "wal.lock"
 
+# Crash-point labels a ``crash_hook`` observes, in the order one append
+# can traverse them. "append" fires with the encoded frame about to be
+# written (a hook raising SimulatedCrash(torn_bytes=k) leaves the first k
+# bytes of that frame on disk — a torn write); "append.flushed" fires
+# after the frame reached the OS; "fsync"/"fsync.done" bracket each fsync
+# syscall; "rotate"/"rotate.done" bracket a segment roll.
+CRASH_POINTS = (
+    "append",
+    "append.flushed",
+    "fsync",
+    "fsync.done",
+    "rotate",
+    "rotate.done",
+)
+
+
+class SimulatedCrash(RuntimeError):
+    """Raised by a WAL ``crash_hook`` to simulate ``kill -9`` at a chosen
+    boundary. The writer dies exactly as a killed process would: file
+    handles and the cross-process flock are released WITHOUT the close
+    path's final fsync, on-disk bytes stay whatever previous flushes left
+    (plus, for ``torn_bytes > 0`` at an "append" point, a partial frame —
+    the torn tail recovery must truncate). The exception propagates to
+    the caller, which treats the engine as dead and recovers through
+    :meth:`~hashgraph_tpu.wal.DurableEngine.recover` on a fresh writer."""
+
+    def __init__(self, point: str, torn_bytes: int = 0):
+        super().__init__(
+            f"simulated crash at WAL point {point!r}"
+            + (f" (torn after {torn_bytes} bytes)" if torn_bytes else "")
+        )
+        self.point = point
+        self.torn_bytes = torn_bytes
+
 
 def _fsync_dir(path: str) -> None:
     """Persist directory-entry changes. fsync on a segment file makes its
@@ -85,6 +119,7 @@ class WalWriter:
         fsync_policy: str = FSYNC_BATCH,
         fsync_interval: int = 256,
         tracer=None,
+        crash_hook=None,
     ):
         if fsync_policy not in _POLICIES:
             raise ValueError(
@@ -102,6 +137,10 @@ class WalWriter:
         self._lock = threading.Lock()
         self._since_fsync = 0
         self._closed = False
+        # ``crash_hook(point)`` fires at every CRASH_POINTS boundary; it
+        # may raise SimulatedCrash to kill the writer there (see _crash).
+        # Deterministic-chaos seam — None in production.
+        self._crash_hook = crash_hook
         os.makedirs(self._dir, exist_ok=True)
 
         # Cross-process exclusivity: two writers on one directory would
@@ -217,12 +256,14 @@ class WalWriter:
                 raise ValueError("WalWriter is closed")
             lsn = self._next_lsn
             frame = F.encode_record(lsn, kind, payload)
+            self._crash("append", frame)
             self._file.write(frame)
             # Flush to the page cache on EVERY append: the policy dial is
             # fsync (durability vs the OS/power failure), not write(2) —
             # an acknowledged record must survive a *process* crash under
             # every policy, and user-space buffering would break that.
             self._file.flush()
+            self._crash("append.flushed")
             self._next_lsn = lsn + 1
             self._segment_size += len(frame)
             self._total_bytes += len(frame)
@@ -276,6 +317,59 @@ class WalWriter:
             for handle in self._gauge_handles:
                 handle.unregister()
 
+    def abandon(self) -> None:
+        """Simulated ``kill -9``: release the file handles and the
+        cross-process flock WITHOUT the close path's final fsync. On-disk
+        bytes stay exactly what previous flushes left (every append
+        flushes to the page cache, so only an in-progress torn write —
+        see :class:`SimulatedCrash` — can leave a partial frame). A fresh
+        writer can then reopen the directory, which is how the chaos
+        harness restarts a crashed peer in-process."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            for handle in (self._file, self._lock_file):
+                try:
+                    handle.close()
+                except OSError:
+                    pass
+            for handle in self._gauge_handles:
+                handle.unregister()
+
+    def set_crash_hook(self, hook) -> None:
+        """Install/replace the crash hook (``None`` removes it)."""
+        self._crash_hook = hook
+
+    def _crash(self, point: str, frame: bytes | None = None) -> None:
+        """Fire the crash hook at ``point`` (lock held). A raised
+        :class:`SimulatedCrash` kills the writer in place: for a
+        ``torn_bytes``-carrying crash at an "append" point the first k
+        bytes of the un-written frame land on disk first (the torn write
+        the recovery scan must detect and truncate), then handles and
+        the flock are released crash-style and the exception
+        propagates."""
+        hook = self._crash_hook
+        if hook is None:
+            return
+        try:
+            hook(point)
+        except SimulatedCrash as crash:
+            if frame is not None and crash.torn_bytes > 0:
+                self._file.write(frame[: min(crash.torn_bytes, len(frame))])
+            try:
+                self._file.close()  # flushes buffered bytes; no fsync
+            except OSError:
+                pass
+            try:
+                self._lock_file.close()
+            except OSError:
+                pass
+            self._closed = True
+            for handle in self._gauge_handles:
+                handle.unregister()
+            raise
+
     def __enter__(self) -> "WalWriter":
         return self
 
@@ -312,9 +406,11 @@ class WalWriter:
     # ── Internals ──────────────────────────────────────────────────────
 
     def _fsync_locked(self) -> None:
+        self._crash("fsync")
         self._file.flush()
         start = time.perf_counter()
         os.fsync(self._file.fileno())
+        self._crash("fsync.done")
         # wal_fsync_seconds is THE durability/throughput dial's price tag:
         # one observation per fsync syscall, always on.
         self._m_fsync.observe(time.perf_counter() - start)
@@ -325,6 +421,7 @@ class WalWriter:
         """Seal the current segment (flush + fsync so sealed segments are
         durable and repair stays confined to the active one) and open a new
         segment based at the next LSN."""
+        self._crash("rotate")
         self._fsync_locked()
         self._file.close()
         self._segment_base = self._next_lsn
@@ -337,3 +434,4 @@ class WalWriter:
         # it are acknowledged (file fsync alone doesn't persist existence).
         _fsync_dir(self._dir)
         self._tracer.count("wal.rotate")
+        self._crash("rotate.done")

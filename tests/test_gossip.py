@@ -782,3 +782,120 @@ class TestGossipNode:
         finally:
             node.close()
             self._teardown(servers, clients)
+
+
+class TestReconnectPolicy:
+    """Opt-in bounded jittered auto-reconnect (satellite): a dropped
+    channel heals — fresh socket, fresh HELLO — while in-flight requests
+    still fail typed. Without the policy, dead channels stay dead (the
+    pre-existing contract, unchanged)."""
+
+    def test_policy_delay_is_bounded_and_jittered(self):
+        from hashgraph_tpu.bridge.client import ReconnectPolicy
+
+        policy = ReconnectPolicy(
+            max_attempts=5, base_delay=0.1, max_delay=0.4, jitter=0.5
+        )
+        for attempt in range(10):
+            d = policy.delay(attempt)
+            assert 0 <= d <= 0.4
+        with pytest.raises(ValueError):
+            ReconnectPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            ReconnectPolicy(jitter=1.5)
+
+    def test_gossip_transport_reconnects_after_server_restart(self):
+        from hashgraph_tpu.bridge.client import ReconnectPolicy
+        from hashgraph_tpu.gossip.transport import GossipTransport
+
+        first = BridgeServer(capacity=8, voter_capacity=4)
+        host, port = first.start()
+        transport = GossipTransport(
+            reconnect=ReconnectPolicy(
+                max_attempts=40, base_delay=0.02, max_delay=0.05
+            )
+        )
+        try:
+            transport.connect("peer", host, port)
+            assert transport.request("peer", P.OP_PING).result(5)
+            first.stop()
+            # The restarted server binds the SAME port (the crash-restart
+            # shape); the transport's backoff loop re-dials + re-HELLOs.
+            with BridgeServer(capacity=8, voter_capacity=4, port=port):
+                deadline = time.monotonic() + 10
+                healed = False
+                while time.monotonic() < deadline:
+                    channel = transport.channel("peer")
+                    if channel is not None and channel.alive:
+                        try:
+                            transport.request("peer", P.OP_PING).result(5)
+                            healed = True
+                            break
+                        except (BridgeError, ConnectionError, TimeoutError):
+                            pass
+                    time.sleep(0.02)
+                assert healed, "channel did not heal after restart"
+        finally:
+            transport.close()
+            first.stop()
+
+    def test_pipelined_client_reconnects_after_server_restart(self):
+        from hashgraph_tpu.bridge.client import (
+            PipelinedBridgeClient,
+            ReconnectPolicy,
+        )
+
+        first = BridgeServer(capacity=8, voter_capacity=4)
+        host, port = first.start()
+        client = PipelinedBridgeClient(
+            host, port,
+            reconnect=ReconnectPolicy(
+                max_attempts=40, base_delay=0.02, max_delay=0.05
+            ),
+        )
+        try:
+            assert client.pipelined
+            assert client.ping() == P.PROTOCOL_VERSION
+            first.stop()
+            with BridgeServer(capacity=8, voter_capacity=4, port=port):
+                deadline = time.monotonic() + 10
+                healed = False
+                while time.monotonic() < deadline:
+                    try:
+                        if client.ping() == P.PROTOCOL_VERSION:
+                            healed = True
+                            break
+                    except (ConnectionError, BridgeError, TimeoutError):
+                        pass
+                    time.sleep(0.02)
+                assert healed, "client did not heal after restart"
+        finally:
+            client.close()
+            first.stop()
+
+    def test_without_policy_channel_stays_dead(self):
+        from hashgraph_tpu.gossip.transport import GossipTransport
+
+        server = BridgeServer(capacity=8, voter_capacity=4)
+        host, port = server.start()
+        transport = GossipTransport()  # no reconnect: the old contract
+        try:
+            transport.connect("peer", host, port)
+            transport.request("peer", P.OP_PING).result(5)
+            server.stop()
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline:
+                channel = transport.channel("peer")
+                if channel is not None and not channel.alive:
+                    break
+                try:
+                    transport.request("peer", P.OP_PING).result(0.2)
+                except Exception:
+                    pass
+                time.sleep(0.02)
+            channel = transport.channel("peer")
+            assert channel is not None and not channel.alive
+            time.sleep(0.3)  # a reconnector would have re-dialed by now
+            assert not transport.channel("peer").alive
+        finally:
+            transport.close()

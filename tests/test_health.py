@@ -236,6 +236,10 @@ class TestEquivocation:
 
 class TestForkAndTruncation:
     def test_fork_redelivery_retains_evidence(self):
+        """Fork conviction requires the double-sign bar: the divergent
+        vote's owner must also have a DIFFERENT accepted vote in the
+        session — then the retained pair is two votes signed by one
+        identity, offline-verifiable misbehavior proof."""
         monitor = fresh_monitor()
         engine = make_engine(monitor)
         proposal, chain = make_chain(engine, n_votes=6, scope="r")
@@ -243,7 +247,10 @@ class TestForkAndTruncation:
         receiver = make_engine(receiver_monitor)
         assert receiver.deliver_proposal("r", grown(chain, 4), NOW + 20) == OK
         fork = grown(chain, 5)
-        forger = StubConsensusSigner(b"\x91" * 20)
+        # The signer of accepted vote 2 double-signs: its position in the
+        # fork carries a different vote by the SAME identity.
+        forger = StubConsensusSigner(bytes([3]) * 20)
+        assert chain.votes[2].vote_owner == forger.identity()
         fork.votes[2] = build_vote(proposal, True, forger, NOW + 40)
         code = receiver.deliver_proposal("r", fork, NOW + 41)
         assert code == int(StatusCode.PROPOSAL_ALREADY_EXIST)  # API unchanged
@@ -251,15 +258,40 @@ class TestForkAndTruncation:
         assert record["kind"] == KIND_FORK
         assert record["offender"] == forger.identity().hex()
         assert record["verified"] is False  # captured crypto-free
-        # The pair is the accepted vote vs the divergent one at the same
-        # chain position.
+        # The pair is the offender's ACCEPTED vote vs its divergent one —
+        # both signed by the offender, self-authenticating offline.
         a = Vote.decode(bytes.fromhex(record["vote_a"]))
         b = Vote.decode(bytes.fromhex(record["vote_b"]))
         assert a.vote_hash == chain.votes[2].vote_hash
+        assert a.vote_owner == forger.identity()
         assert b.vote_owner == forger.identity()
+        assert a.vote_hash != b.vote_hash
         card = receiver_monitor.scorecard(forger.identity())
         assert card["fork_redeliveries"] == 1
         assert card["grade"] == GRADE_SUSPECT
+
+    def test_divergence_by_unrelated_signer_is_not_evidence(self):
+        """An honest vote can land at a different chain position under
+        loss/reorder (or a racing embedder): a positional divergence
+        whose signer has no other accepted vote proves nothing and must
+        NOT defame that signer — no evidence, no scorecard hit, grade
+        stays healthy (the chaos harness's zero-false-conviction bar)."""
+        engine = make_engine()
+        proposal, chain = make_chain(engine, n_votes=6, scope="r")
+        receiver_monitor = fresh_monitor()
+        receiver = make_engine(receiver_monitor)
+        assert receiver.deliver_proposal("r", grown(chain, 4), NOW + 20) == OK
+        fork = grown(chain, 5)
+        stranger = StubConsensusSigner(b"\x91" * 20)
+        fork.votes[2] = build_vote(proposal, True, stranger, NOW + 40)
+        code = receiver.deliver_proposal("r", fork, NOW + 41)
+        assert code == int(StatusCode.PROPOSAL_ALREADY_EXIST)
+        assert receiver_monitor.evidence_count() == 0
+        card = receiver_monitor.scorecard(stranger.identity())
+        assert card is None or card["grade"] == GRADE_HEALTHY
+        # The honest signer whose vote the fork displaced is untouched.
+        displaced = receiver_monitor.scorecard(chain.votes[2].vote_owner)
+        assert displaced is None or displaced["fork_redeliveries"] == 0
 
     def test_truncation_scores_chain_lag(self):
         engine = make_engine()
@@ -560,15 +592,17 @@ class TestBridgeHealth:
                 v2 = build_vote(view, False, signer, NOW + 2)
                 with pytest.raises(Exception):
                     client.process_vote(peer, "h", v2.encode(), NOW + 2)
-                # Fork: a redelivered chain whose first position diverges
-                # from the accepted watermark, driven through the peer
-                # engine's deliver_proposal (the gossip-facing surface).
+                # Fork: a redelivered chain in which the signer's OWN
+                # accepted vote is replaced by a different vote it signed
+                # (the double-sign bar — a divergence at another owner's
+                # position is honestly producible and records nothing),
+                # driven through the peer engine's deliver_proposal (the
+                # gossip-facing surface).
                 honest = Proposal.decode(client.get_proposal(peer, "h", pid))
-                forger = EthereumConsensusSigner.random()
                 forked_long = honest.clone()
                 forked_long.votes = [
                     build_vote(
-                        Proposal.decode(proposal_bytes), True, forger, NOW + 4
+                        Proposal.decode(proposal_bytes), False, signer, NOW + 4
                     )
                 ] + [v.clone() for v in honest.votes]
                 engine = server._peers[peer].engine

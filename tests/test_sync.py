@@ -698,3 +698,77 @@ def test_recover_shard_surfaces_wal_recover_stats(tmp_path):
         assert health["wal_recover"] == occ["wal_recover"]
     finally:
         fleet.close()
+
+
+class TestSyncTimeouts:
+    """Wall-clock timeouts on catch-up network operations (satellite): a
+    stalled source raises the typed SyncTimeoutError instead of hanging
+    the joiner thread; verified progress survives in the CatchUpState."""
+
+    def test_stalled_source_socket_times_out_typed(self):
+        import socket as _socket
+        import threading
+
+        from hashgraph_tpu.sync import SyncTimeoutError
+
+        listener = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        listener.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        host, port = listener.getsockname()[:2]
+        held: list = []
+
+        def accept_and_stall():
+            conn, _ = listener.accept()
+            held.append(conn)  # read the request, answer NOTHING
+
+        thread = threading.Thread(target=accept_and_stall, daemon=True)
+        thread.start()
+        engine = fresh_engine(b"stalled-joiner------")
+        client = CatchUpClient(host, port, 1, timeout=0.3)
+        try:
+            with pytest.raises(SyncTimeoutError) as excinfo:
+                client.catch_up(engine)
+            assert excinfo.value.operation == "manifest request"
+            assert excinfo.value.timeout == 0.3
+        finally:
+            client.close()
+            for conn in held:
+                conn.close()
+            listener.close()
+
+    def test_timeout_during_chunk_names_the_operation(self):
+        from hashgraph_tpu.sync import SyncTimeoutError
+
+        class StallingBridge:
+            def __init__(self):
+                self.manifest_calls = 0
+
+            def sync_manifest(self, peer, max_chunk_bytes=0):
+                self.manifest_calls += 1
+                return {
+                    "snapshot_id": 1, "watermark": 5, "total_bytes": 64,
+                    "chunk_bytes": 64, "session_count": 1,
+                    "config_count": 0, "chunk_count": 1,
+                    "digests": [b"\x00" * 32],
+                }
+
+            def sync_chunk(self, peer, snapshot_id, index):
+                raise TimeoutError("recv timed out")
+
+            def wal_tail(self, peer, after_lsn, max_bytes=0):
+                raise AssertionError("never reached")
+
+            def close(self):
+                pass
+
+        engine = fresh_engine(b"chunk-stall-joiner--")
+        client = CatchUpClient(
+            "ignored", 0, 1, timeout=0.5, bridge=StallingBridge()
+        )
+        with pytest.raises(SyncTimeoutError) as excinfo:
+            client.catch_up(engine)
+        assert "chunk 0" in excinfo.value.operation
+        # Progress stays resumable: the manifest survived into the state.
+        assert client.state.manifest is not None
+        client.close()
