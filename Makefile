@@ -56,16 +56,23 @@ bench-catchup:
 catchup-smoke:
 	JAX_PLATFORMS=cpu python examples/catchup_smoke.py
 
-# Networked gossip bench: N peers as separate OS processes over real TCP,
-# aggregate networked votes/sec, paired same-window A/B against the
-# serial BridgeClient loop with a machine-readable noise_verdict, and
-# per-rep cross-peer state_fingerprint equality asserts.
+# Networked gossip bench: N peers as separate OS processes over real TCP
+# (plus the shared-memory ring lane for the co-located case), aggregate
+# networked votes/sec, paired same-window A/B against the serial
+# BridgeClient loop with a machine-readable noise_verdict, per-rep
+# cross-peer state_fingerprint equality asserts, and per-rep wire-path
+# stage attribution (decode / crypto / device-apply seconds). STAGES=1
+# passes --stages explicitly; STAGES=0 drops the attribution block.
+STAGES ?= 1
 bench-gossip:
-	python bench.py gossip
+	python bench.py gossip $(if $(filter 0,$(STAGES)),--no-stages,--stages)
 
-# CI short run: 3 in-process peers — pipelining + coalescing + a
-# sampled-fanout divergence healed by ONE anti-entropy round, final
-# state fingerprint-identical across peers.
+# CI short run: 3 in-process peers — pipelining + coalescing + the
+# zero-copy columnar OP_VOTE_BATCH server path + a sampled-fanout
+# divergence healed by ONE anti-entropy round, final state
+# fingerprint-identical across peers. CI runs it twice: native parser
+# available, and HASHGRAPH_TPU_WIRE_COLUMNAR=0 forcing the pure-Python
+# object fallback path (which must stay green on its own).
 gossip-smoke:
 	JAX_PLATFORMS=cpu python bench.py gossip --smoke
 

@@ -2027,6 +2027,7 @@ def run_gossip(
     chunk: int = 16,
     reps: int = 3,
     smoke: bool = False,
+    stages: bool = True,
 ) -> dict:
     """Networked gossip fabric: aggregate votes/sec ACROSS A SOCKET.
 
@@ -2173,7 +2174,14 @@ def run_gossip(
 
     def run_fabric(epoch) -> float:
         if not fabric_node:
-            node = GossipNode("bench-driver", fanout=None, flush_votes=512)
+            # Full bench: peers are co-located OS processes — attach the
+            # shared-memory ring lane (FEATURE_SHM_RING; TCP fallback is
+            # automatic when a peer can't map the rings). The smoke's
+            # in-process peers keep TCP so CI covers both lanes.
+            node = GossipNode(
+                "bench-driver", fanout=None, flush_votes=512,
+                shm_ring_bytes=None if smoke else 8 * 1024 * 1024,
+            )
             for i, address in enumerate(addresses):
                 node.add_peer(f"peer{i}", *address, peer_ids[i])
             fabric_node.append(node)
@@ -2206,6 +2214,37 @@ def run_gossip(
         mid = vals[len(vals) // 2]
         return round(100.0 * (vals[-1] - vals[0]) / mid, 1) if mid else 0.0
 
+    # Stage attribution: the servers' wire-path counters (decode /
+    # crypto / device-apply wall seconds + frames per path) scraped over
+    # GET_METRICS, summed across peer processes. In-process smoke peers
+    # share one registry, so scrape exactly one client there.
+    _STAGE_FAMILIES = {
+        "hashgraph_bridge_wire_decode_seconds_total": "wire_decode_s",
+        "hashgraph_bridge_wire_crypto_seconds_total": "crypto_s",
+        "hashgraph_bridge_wire_apply_seconds_total": "device_apply_s",
+        "hashgraph_bridge_wire_columnar_frames_total": "columnar_frames",
+        "hashgraph_bridge_wire_fallback_frames_total": "fallback_frames",
+        "hashgraph_bridge_shm_rings_attached_total": "shm_rings",
+    }
+
+    def scrape_stages() -> "dict[str, float]":
+        out = {name: 0.0 for name in _STAGE_FAMILIES.values()}
+        for client in clients[:1] if smoke else clients:
+            for line in client.get_metrics().splitlines():
+                if line.startswith("#") or " " not in line:
+                    continue
+                family, _, value = line.partition(" ")
+                key = _STAGE_FAMILIES.get(family)
+                if key is not None:
+                    out[key] += float(value)
+        return out
+
+    def stage_delta(before: dict, after: dict) -> dict:
+        return {
+            key: round(after[key] - before[key], 4)
+            for key in before
+        }
+
     try:
         # Untimed warmup pair: jit at these shapes, connection setup.
         run_serial(build_epoch("w-a"))
@@ -2213,12 +2252,17 @@ def run_gossip(
 
         a_rates: list[float] = []
         b_rates: list[float] = []
+        stage_reps: list[dict] = []
         controls: list[float] = [control_rate()]
         for rep in range(reps):
             a_rates.append(networked / run_serial(build_epoch(f"r{rep}-a")))
             controls.append(control_rate())
+            before = scrape_stages() if stages else None
             b_rates.append(networked / run_fabric(build_epoch(f"r{rep}-b")))
+            if stages:
+                stage_reps.append(stage_delta(before, scrape_stages()))
             controls.append(control_rate())
+        final_stages = scrape_stages() if stages else None
 
         # Smoke convergence phase: sampled fanout misses peers on
         # purpose; ONE anti-entropy round (same logical now) repairs
@@ -2305,6 +2349,31 @@ def run_gossip(
         "fingerprints_identical": True,  # asserted every rep, both arms
         "noise_verdict": noise_verdict,
     }
+    if stages and stage_reps:
+        # Per-rep wall seconds inside the fabric arm's server path (wire
+        # decode / crypto / device apply) plus frames per path: the
+        # residual gap to the in-process number is attributable stage by
+        # stage, and a regression in any one stage is visible in the
+        # BENCH json without re-profiling. shm_rings reports the
+        # ABSOLUTE attach count (attachment happens once at warmup, so a
+        # per-rep delta would always read 0).
+        totals = {
+            key: round(sum(rep[key] for rep in stage_reps), 4)
+            for key in stage_reps[0]
+        }
+        totals["shm_rings"] = final_stages["shm_rings"]
+        busy = sum(
+            totals[key]
+            for key in ("wire_decode_s", "crypto_s", "device_apply_s")
+        )
+        detail["stage_attribution"] = {
+            "per_rep": stage_reps,
+            "totals": totals,
+            "stage_share": {
+                key: round(totals[key] / busy, 3) if busy else 0.0
+                for key in ("wire_decode_s", "crypto_s", "device_apply_s")
+            },
+        }
     if smoke:
         detail["convergence"] = convergence
     return {
@@ -2719,6 +2788,18 @@ if __name__ == "__main__":
     # (e.g. this interpreter's sitecustomize compiled on the real chip),
     # the fleet falls back to shards sharing a device and says so in
     # ``tally_path``.
+    # gossip --stages: force the wire-path stage-attribution block into
+    # the BENCH json (decode / crypto / device-apply seconds per rep).
+    # Attribution is on by default; the flag exists so `make
+    # bench-gossip STAGES=1` has an explicit, stable spelling and so it
+    # can be turned OFF (--no-stages) for minimal artifacts.
+    gossip_stages = True
+    if "--stages" in args:
+        args.remove("--stages")
+    if "--no-stages" in args:
+        args.remove("--no-stages")
+        gossip_stages = False
+
     fleet_smoke = "--smoke" in args
     if fleet_smoke:
         args.remove("--smoke")
@@ -2874,7 +2955,7 @@ if __name__ == "__main__":
         "wal": run_wal,
         "fleet": lambda: run_fleet(smoke=fleet_smoke),
         "catchup": lambda: run_catchup(smoke=fleet_smoke),
-        "gossip": lambda: run_gossip(smoke=fleet_smoke),
+        "gossip": lambda: run_gossip(smoke=fleet_smoke, stages=gossip_stages),
         "chaos": lambda: run_chaos(smoke=fleet_smoke),
         "default": run_default,
     }

@@ -78,6 +78,8 @@ from __future__ import annotations
 import socket as _socket
 import struct
 
+import numpy as np
+
 from ..obs.trace import TRACE_WIRE_BYTES, TraceContext
 
 PROTOCOL_VERSION = 1
@@ -157,14 +159,46 @@ OP_DELIVER_PROPOSALS = 20
 # networked peers cannot).
 OP_STATE_FINGERPRINT = 21
 
+# SHM_ATTACH (FEATURE_SHM_RING; pipelined connections only): u32
+# ring_bytes | string c2s shm name | string s2c shm name -> empty OK.
+# The client creates two single-producer single-consumer shared-memory
+# byte rings (hashgraph_tpu.gossip.shm layout) and the server maps them;
+# from the OK on, the client MAY send any tagged request frame through
+# the c2s ring and the server answers through the s2c ring. The TCP
+# socket stays open as the control/fallback lane and its close tears the
+# rings down. Co-located peers skip the kernel socket path entirely —
+# a frame is one memcpy each way.
+OP_SHM_ATTACH = 22
+
+# Opcodes that mutate server-side state (plus POLL_EVENTS, whose read is
+# DESTRUCTIVE — it drains the peer's event queue). On a pipelined
+# connection the server executes these in receive order per connection;
+# read-only opcodes dispatch concurrently and may complete out of order.
+# The client transport uses the same set to keep an ordered stream on
+# ONE lane when a connection carries both a shm ring and the TCP
+# control/fallback lane (see gossip.transport.GossipTransport).
+MUTATING_OPCODES = frozenset({
+    OP_ADD_PEER,
+    OP_CREATE_PROPOSAL,
+    OP_CAST_VOTE,
+    OP_PROCESS_PROPOSAL,
+    OP_PROCESS_VOTE,
+    OP_PROCESS_VOTES,
+    OP_VOTE_BATCH,
+    OP_DELIVER_PROPOSALS,
+    OP_HANDLE_TIMEOUT,
+    OP_POLL_EVENTS,
+})
+
 # HELLO feature bits.
 FEATURE_PIPELINING = 1 << 0
 FEATURE_VOTE_BATCH = 1 << 1
 FEATURE_DELIVER = 1 << 2
 FEATURE_EVENT_BOUND = 1 << 3
+FEATURE_SHM_RING = 1 << 4
 SUPPORTED_FEATURES = (
     FEATURE_PIPELINING | FEATURE_VOTE_BATCH | FEATURE_DELIVER
-    | FEATURE_EVENT_BOUND
+    | FEATURE_EVENT_BOUND | FEATURE_SHM_RING
 )
 
 # Bridge-level statuses (protocol StatusCode values occupy 0..29).
@@ -254,6 +288,17 @@ class Cursor:
 
     def blob(self) -> bytes:
         return self._take(self.u32())
+
+    def skip(self, n: int) -> None:
+        if self._pos + n > len(self._data):
+            raise ValueError("frame truncated")
+        self._pos += n
+
+    def fork(self) -> "Cursor":
+        """Independent cursor at the current position over the same
+        buffer — lets a fast path consume the frame and still hand the
+        untouched bytes to the fallback decoder."""
+        return Cursor(self._data, self._pos)
 
     def done(self) -> bool:
         return self._pos == len(self._data)
@@ -357,6 +402,31 @@ def read_tagged_frame(sock) -> tuple[int, int, Cursor]:
     return body[0], _U32.unpack_from(body, 1)[0], Cursor(body, 5)
 
 
+def split_frames(buf: bytearray, min_len: int = 1) -> "list[bytes]":
+    """Split every COMPLETE length-prefixed frame body off the front of
+    ``buf`` (mutated in place; a trailing partial frame stays buffered
+    for the next feed). One home for the accumulate/length-check/slice
+    loop every buffered lane runs — the TCP reader and both shm ring
+    readers stay provably consistent. Raises ValueError on a
+    structurally impossible length: the stream has lost framing and the
+    caller must kill it (frames split earlier in the same feed are
+    dropped with it — their futures fail typed when the lane dies)."""
+    frames: list[bytes] = []
+    pos = 0
+    n = len(buf)
+    while n - pos >= 4:
+        (length,) = _U32.unpack_from(buf, pos)
+        if length < min_len or length > MAX_FRAME:
+            raise ValueError(f"bad frame length {length}")
+        if n - pos < 4 + length:
+            break
+        frames.append(bytes(buf[pos + 4 : pos + 4 + length]))
+        pos += 4 + length
+    if pos:
+        del buf[:pos]
+    return frames
+
+
 def parse_frame(body: bytes, tagged: bool) -> tuple[int, int, Cursor]:
     """Parse one already-read frame body (the length prefix stripped):
     returns (lead, correlation id — 0 when untagged, payload cursor).
@@ -398,6 +468,73 @@ def encode_vote_batch(
             lens.append(u32(len(v)))
             bodies.append(v)
     return b"".join(head) + b"".join(lens) + b"".join(bodies)
+
+
+def encode_vote_batch_segments(
+    now: int, groups: "list[tuple[int, str, list[bytes]]]"
+) -> "tuple[list[bytes], int]":
+    """Scatter-gather :func:`encode_vote_batch`: returns ``(segments,
+    total_bytes)`` where the segments are the frame head (header fields +
+    length columns, one joined blob) followed by the vote payloads AS THE
+    CALLER'S OWN bytes objects — no concatenation copy of the vote
+    region. ``b"".join(segments)`` equals :func:`encode_vote_batch`'s
+    output byte for byte; the transport hands the list to
+    ``socket.sendmsg`` (or writes it segment-wise into a shm ring)."""
+    head = [u64(now), u32(len(groups))]
+    lens: list[bytes] = []
+    bodies: list[bytes] = []
+    body_bytes = 0
+    for peer_id, scope, votes in groups:
+        head.append(u32(peer_id) + string(scope) + u32(len(votes)))
+        for v in votes:
+            lens.append(u32(len(v)))
+            bodies.append(v)
+            body_bytes += len(v)
+    lead = b"".join(head) + b"".join(lens)
+    return [lead, *bodies], len(lead) + body_bytes
+
+
+class VoteBatchView:
+    """Zero-copy columnar view of one decoded ``OP_VOTE_BATCH`` payload:
+    group metadata plus numpy views (no per-vote slicing) over the
+    length column and the contiguous vote-bytes region."""
+
+    __slots__ = ("now", "groups", "offsets", "data", "total")
+
+    def __init__(self, now, groups, offsets, data, total):
+        self.now = now
+        self.groups = groups  # [(peer_id, scope, vote_count)]
+        self.offsets = offsets  # int64[total+1], absolute into `data`
+        self.data = data  # uint8 view over the frame's vote region
+        self.total = total
+
+
+def decode_vote_batch_views(c: Cursor) -> VoteBatchView:
+    """Columnar :func:`decode_vote_batch`: same header walk (so
+    malformed frames raise the same ``ValueError`` the object decoder
+    would), but the length column becomes one u32 numpy view and the
+    vote bytes stay one contiguous uint8 view — zero per-vote Python
+    objects. Trailing bytes past the vote region are tolerated exactly
+    as the object decoder tolerates them."""
+    now = c.u64()
+    groups: list[tuple[int, str, int]] = []
+    for _ in range(c.u32()):
+        peer_id = c.u32()
+        scope = c.string()
+        groups.append((peer_id, scope, c.u32()))
+    total = sum(g[2] for g in groups)
+    if c.remaining() < 4 * total:
+        raise ValueError("frame truncated")
+    lens = np.frombuffer(c._data, np.dtype("<u4"), count=total, offset=c._pos)
+    c.skip(4 * total)
+    offsets = np.zeros(total + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    need = int(offsets[-1])
+    if c.remaining() < need:
+        raise ValueError("frame truncated")
+    data = np.frombuffer(c._data, np.uint8, count=need, offset=c._pos)
+    c.skip(need)
+    return VoteBatchView(now, groups, offsets, data, total)
 
 
 def decode_vote_batch(

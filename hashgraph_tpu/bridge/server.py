@@ -26,13 +26,21 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from struct import error as struct_error
 
+import numpy as np
+
 from ..engine import TpuConsensusEngine, VerifiedVoteCache
 from ..errors import ConsensusError
 from ..events import BroadcastEventBus, EventReceiver
 from ..obs import (
     BRIDGE_ERRORS_TOTAL,
     BRIDGE_REQUESTS_TOTAL,
+    SHM_RINGS_ATTACHED_TOTAL,
     SYNC_CHUNKS_SENT_TOTAL,
+    WIRE_APPLY_SECONDS_TOTAL,
+    WIRE_COLUMNAR_FRAMES_TOTAL,
+    WIRE_CRYPTO_SECONDS_TOTAL,
+    WIRE_DECODE_SECONDS_TOTAL,
+    WIRE_FALLBACK_FRAMES_TOTAL,
     HealthMonitor,
     MetricsSidecar,
     flight_recorder,
@@ -100,10 +108,22 @@ class _SerialLane:
                 pass
 
 
+class _WireFramePrep:
+    """One prepared OP_VOTE_BATCH frame on the columnar fast path: the
+    decoded views plus per-peer row groups, each with its validation
+    prepass already in flight on the verify pool."""
+
+    __slots__ = ("view", "per_peer")
+
+    def __init__(self, view, per_peer):
+        self.view = view
+        self.per_peer = per_peer
+
+
 class _ConnState:
     """Per-connection pipelining state (created on HELLO upgrade)."""
 
-    __slots__ = ("write_lock", "inflight", "ordered")
+    __slots__ = ("write_lock", "inflight", "ordered", "shm_running")
 
     def __init__(self, pool: ThreadPoolExecutor, max_inflight: int):
         self.write_lock = threading.Lock()
@@ -112,26 +132,23 @@ class _ConnState:
         # unboundedly — TCP backpressure does the rest.
         self.inflight = threading.BoundedSemaphore(max_inflight)
         self.ordered = _SerialLane(pool)
+        # Flipped off when the owning TCP connection unwinds: the shm
+        # serving thread (if any) watches it and exits.
+        self.shm_running = True
 
 
-# Opcodes that mutate server-side state: on a pipelined connection these
-# execute in receive order (per connection); read-only opcodes dispatch
-# concurrently and may complete out of order. POLL_EVENTS is here
-# because its read is DESTRUCTIVE (it drains the peer's event queue) —
-# two concurrent polls would split the event stream across responses
-# that can arrive in either order.
-_ORDERED_OPCODES = frozenset({
-    P.OP_ADD_PEER,
-    P.OP_CREATE_PROPOSAL,
-    P.OP_CAST_VOTE,
-    P.OP_PROCESS_PROPOSAL,
-    P.OP_PROCESS_VOTE,
-    P.OP_PROCESS_VOTES,
-    P.OP_VOTE_BATCH,
-    P.OP_DELIVER_PROPOSALS,
-    P.OP_HANDLE_TIMEOUT,
-    P.OP_POLL_EVENTS,
-})
+# Opcodes that execute in receive order on a pipelined connection; the
+# set lives in protocol.py because the client transport's lane routing
+# must agree with it (see MUTATING_OPCODES there for the rationale).
+_ORDERED_OPCODES = P.MUTATING_OPCODES
+
+# Reader-thread verdict: "_vote_batch_prepare already ran and chose the
+# object fallback (a non-canonical row)" — the serial lane goes straight
+# to the object path instead of re-decoding + re-parsing the frame just
+# to reach the same conclusion. Distinct from None, which means "not
+# attempted" (no reader prepass) or "prepare raised" (the lane re-runs
+# the decode so the wire error contract answers with the exact message).
+_PREP_FALLBACK = object()
 
 
 @contextlib.contextmanager
@@ -201,6 +218,7 @@ class BridgeServer:
         signer_factory: type | None = None,
         pipeline_workers: int | None = None,
         max_inflight_per_connection: int = 256,
+        wire_columnar: "bool | None" = None,
     ):
         self._host = host
         self._port = port
@@ -307,6 +325,39 @@ class BridgeServer:
         self._pipeline_workers = max(1, pipeline_workers)
         self._max_inflight = max(1, max_inflight_per_connection)
         self._pipeline_pool: ThreadPoolExecutor | None = None
+        # Zero-copy wire ingest: OP_VOTE_BATCH frames whose rows all parse
+        # strict-canonical land as numpy columns on ingest_wire_columnar
+        # (full validation, no per-vote Python objects); anything else —
+        # and engines without the columnar entry point — takes the object
+        # path, which stays the parity oracle. Default on; force off with
+        # wire_columnar=False or HASHGRAPH_TPU_WIRE_COLUMNAR=0 (the CI
+        # fallback leg runs the smoke that way).
+        if wire_columnar is None:
+            wire_columnar = os.environ.get(
+                "HASHGRAPH_TPU_WIRE_COLUMNAR", "1"
+            ) != "0"
+        self._wire_columnar = bool(wire_columnar)
+        self._m_wire_columnar = default_registry.counter(
+            WIRE_COLUMNAR_FRAMES_TOTAL
+        )
+        self._m_wire_fallback = default_registry.counter(
+            WIRE_FALLBACK_FRAMES_TOTAL
+        )
+        self._m_wire_decode_s = default_registry.counter(
+            WIRE_DECODE_SECONDS_TOTAL
+        )
+        self._m_wire_crypto_s = default_registry.counter(
+            WIRE_CRYPTO_SECONDS_TOTAL
+        )
+        self._m_wire_apply_s = default_registry.counter(
+            WIRE_APPLY_SECONDS_TOTAL
+        )
+        self._m_shm_attached = default_registry.counter(
+            SHM_RINGS_ATTACHED_TOTAL
+        )
+        # Live shm ring pairs: (rx, tx) per serving thread, torn down on
+        # stop() and when the owning TCP connection closes.
+        self._shm_rings: "set[tuple[object, object]]" = set()
 
     # ── lifecycle ──────────────────────────────────────────────────────
 
@@ -459,6 +510,7 @@ class BridgeServer:
                 pass
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
+        self._teardown_shm(None)
         # Join in-flight handlers: a dispatch that was already running keeps
         # the engine lock until it finishes; only after this loop is the
         # "no further frames mutate the peer engines" guarantee true.
@@ -535,6 +587,7 @@ class BridgeServer:
         try:
             self._serve_frames(conn)
         finally:
+            self._teardown_shm(conn)
             with self._lock:
                 self._connections.discard(conn)
                 self._handlers.discard(threading.current_thread())
@@ -573,6 +626,10 @@ class BridgeServer:
                     pool = self._pipeline_pool
                     if pool is not None:
                         state = _ConnState(pool, self._max_inflight)
+                continue
+            if state is not None and opcode == P.OP_SHM_ATTACH:
+                if not self._handle_shm_attach(conn, state, corr, cursor):
+                    return  # write failed; connection is dead
                 continue
             if state is None:
                 status, payload = self._safe_dispatch(opcode, cursor)
@@ -614,11 +671,171 @@ class BridgeServer:
             return None
         return granted
 
-    def _safe_dispatch(self, opcode: int, cursor: P.Cursor) -> tuple[int, bytes]:
+    def _handle_shm_attach(
+        self, conn, state: _ConnState, corr: int, cursor: P.Cursor
+    ) -> bool:
+        """Map the client's ring pair and serve tagged frames from it on
+        a dedicated thread (``OP_SHM_ATTACH``; pipelined connections
+        only). Any failure answers a typed error — the client keeps the
+        TCP lane and simply never upgrades. Returns False only when the
+        response write failed (connection dead)."""
+        status, message = P.STATUS_OK, b""
+        rings = None
+        rx = None
+        try:
+            cursor.u32()  # ring_bytes (informative)
+            c2s = cursor.string()
+            s2c = cursor.string()
+            from ..gossip.shm import ShmRing, shm_available
+
+            if not shm_available():
+                raise ValueError("shared memory unavailable on this host")
+            rx = ShmRing.attach(c2s)
+            tx = ShmRing.attach(s2c)
+            rings = (rx, tx)
+        except (ValueError, OSError) as exc:
+            if rx is not None:  # c2s attached but s2c failed: unmap it
+                rx.close()
+            status, message = P.STATUS_BAD_REQUEST, P.string(str(exc))
+        try:
+            with state.write_lock:
+                conn.sendall(P.encode_tagged_frame(status, corr, message))
+        except OSError:
+            if rings is not None:
+                for ring in rings:
+                    ring.close()
+            return False
+        if rings is None:
+            return True
+        thread = threading.Thread(
+            target=self._serve_shm_ring,
+            args=(conn, state, rings[0], rings[1]),
+            daemon=True,
+            name="bridge-shm",
+        )
+        with self._lock:
+            self._shm_rings.add((conn, state, rings[0], rings[1], thread))
+        self._m_shm_attached.inc()
+        flight_recorder.record("bridge.shm_attach", c2s=c2s, s2c=s2c)
+        thread.start()
+        return True
+
+    def _serve_shm_ring(self, conn, state: _ConnState, rx, tx) -> None:
+        """Reader loop for one attached ring pair: the byte stream is
+        the same tagged frame stream TCP carries, parsed incrementally
+        and dispatched through the connection's pipelining state (same
+        serial lane — vote order is preserved across lanes per opcode
+        stream; the client routes each request to exactly one lane).
+        Responses go back through the tx ring."""
+        from ..gossip.shm import ShmSpin
+
+        spin = ShmSpin()
+        tx_lock = threading.Lock()
+        buf = bytearray()
+        while self._running and state.shm_running:
+            try:
+                chunk = rx.read_available()
+            except (OSError, ValueError):
+                return  # ring closed under us (teardown)
+            if chunk is None:
+                spin.wait()
+                continue
+            spin.hit()
+            buf += chunk
+            try:
+                frames = P.split_frames(buf, min_len=5)
+            except ValueError:
+                # Stream integrity gone: the ring can never recover its
+                # framing, so kill the WHOLE connection — the TCP reader
+                # unblocks, its cleanup tears the rings down, and the
+                # client sees a typed connection loss (then falls back /
+                # reconnects). Stopping just this reader would leave the
+                # client writing into a ring nobody drains.
+                flight_recorder.record("bridge.shm_bad_frame")
+                state.shm_running = False
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                return
+            for body in frames:
+                self._dispatch_shm_frame(body, conn, state, tx, tx_lock)
+
+    def _dispatch_shm_frame(
+        self, body: bytes, conn, state: _ConnState, tx, tx_lock
+    ) -> None:
+        opcode, corr, cursor = P.parse_frame(body, tagged=True)
+        self._m_requests.inc()
+        flight_recorder.record("bridge.op", opcode=opcode)
+        state.inflight.acquire()
+        prep = self._try_vote_batch_prepare(opcode, cursor)
+
+        def run() -> None:
+            try:
+                status, payload = self._safe_dispatch(opcode, cursor, prep)
+                if status >= P.STATUS_UNKNOWN_PEER:
+                    self._m_errors.inc()
+                frame = P.encode_tagged_frame(status, corr, payload)
+                if len(frame) > tx.capacity:
+                    # The ring can NEVER carry this response: answer on
+                    # the TCP control lane instead (the client matches
+                    # responses by corr id across lanes). Spinning on
+                    # try_write would hold tx_lock forever and wedge
+                    # every later response on the connection.
+                    try:
+                        with state.write_lock:
+                            conn.sendall(frame)
+                    except OSError:
+                        pass  # connection died; nothing to answer to
+                    return
+                with tx_lock:
+                    # Response ring full: the client is the sole drainer
+                    # and responses are small — wait briefly rather than
+                    # drop a response (a lost response hangs a future).
+                    try:
+                        while not tx.try_write([frame], len(frame)):
+                            if not (self._running and state.shm_running):
+                                return
+                            time.sleep(0.0005)
+                    except ValueError:
+                        return  # ring closed under us (teardown race)
+            finally:
+                state.inflight.release()
+
+        if opcode in _ORDERED_OPCODES:
+            state.ordered.submit(run)
+        else:
+            pool = self._pipeline_pool
+            if pool is None:
+                run()
+                return
+            try:
+                pool.submit(run)
+            except RuntimeError:
+                run()
+
+    def _teardown_shm(self, conn) -> None:
+        """Stop and unmap every ring pair attached to ``conn`` (or all
+        of them when ``conn`` is None — server stop)."""
+        with self._lock:
+            mine = [
+                entry for entry in self._shm_rings
+                if conn is None or entry[0] is conn
+            ]
+            self._shm_rings.difference_update(mine)
+        for _conn, state, rx, tx, thread in mine:
+            state.shm_running = False
+            thread.join(timeout=2)
+            rx.close()
+            tx.close()
+
+    def _safe_dispatch(
+        self, opcode: int, cursor: P.Cursor, vote_prep=None
+    ) -> tuple[int, bytes]:
         """_dispatch with the wire's error contract applied (one home for
         the serial loop and the pipelined workers)."""
         try:
-            return self._dispatch(opcode, cursor)
+            return self._dispatch(opcode, cursor, vote_prep)
         except ConsensusError as exc:
             return int(exc.code), P.string(str(exc))
         except (ValueError, KeyError, struct_error) as exc:
@@ -635,6 +852,24 @@ class BridgeServer:
             flight_recorder.dump("bridge-dispatch-error")
             return P.STATUS_INTERNAL, P.string(repr(exc))
 
+    def _try_vote_batch_prepare(self, opcode: int, cursor: P.Cursor):
+        """3-stage wire pipeline, stage 1: vote-batch frames parse AND
+        submit their crypto on the calling (reader) thread — GIL-free
+        native parse, async verify-pool submit — so by the time the
+        serial lane reaches the frame, its signatures are already
+        verified or in flight while the previous frame's device apply
+        runs. Returns the prepass, ``_PREP_FALLBACK`` when the parse
+        chose the object path (a non-canonical row), or ``None`` when
+        the lane should re-decode from scratch (not a vote batch /
+        columnar off / parse raised — the lane answers the exact wire
+        error). One home for both the TCP and shm reader threads."""
+        if opcode != P.OP_VOTE_BATCH or not self._wire_columnar:
+            return None
+        try:
+            return self._vote_batch_prepare(cursor.fork()) or _PREP_FALLBACK
+        except Exception:
+            return None  # lane re-decodes and answers the exact error
+
     def _dispatch_pipelined(
         self,
         conn: socket.socket,
@@ -648,10 +883,11 @@ class BridgeServer:
         (receive order); read-only opcodes run concurrently, so their
         responses can overtake — the client matches by correlation id."""
         state.inflight.acquire()  # reader blocks when the window is full
+        prep = self._try_vote_batch_prepare(opcode, cursor)
 
         def run() -> None:
             try:
-                status, payload = self._safe_dispatch(opcode, cursor)
+                status, payload = self._safe_dispatch(opcode, cursor, prep)
                 if status >= P.STATUS_UNKNOWN_PEER:
                     self._m_errors.inc()
                 try:
@@ -678,7 +914,9 @@ class BridgeServer:
 
     # ── dispatch ───────────────────────────────────────────────────────
 
-    def _dispatch(self, opcode: int, c: P.Cursor) -> tuple[int, bytes]:
+    def _dispatch(
+        self, opcode: int, c: P.Cursor, vote_prep=None
+    ) -> tuple[int, bytes]:
         if opcode == P.OP_PING:
             return P.STATUS_OK, P.u32(P.PROTOCOL_VERSION)
         if opcode == P.OP_ADD_PEER:
@@ -691,7 +929,7 @@ class BridgeServer:
             )
         if opcode == P.OP_VOTE_BATCH:
             # Multi-peer frame: groups carry their own peer ids.
-            return self._op_vote_batch(c)
+            return self._op_vote_batch(c, vote_prep)
         handler = _HANDLERS.get(opcode)
         if handler is None:
             return P.STATUS_UNKNOWN_OPCODE, b""
@@ -911,15 +1149,48 @@ class BridgeServer:
     # frames overlap crypto with apply.
     _PIPELINE_SPLIT = 256
 
-    def _op_vote_batch(self, c: P.Cursor) -> tuple[int, bytes]:
-        """Coalesced columnar vote frame (``OP_VOTE_BATCH``): many
-        (peer_id, scope) groups of small vote payloads land in ONE frame
-        and ONE pipelined engine dispatch per peer —
-        :meth:`TpuConsensusEngine.ingest_votes_pipelined` overlaps group
-        k+1's signature prepass with group k's apply. Per-vote statuses
-        come back in flattened batch order; an undecodable blob marks
-        its row 241 and an unknown peer_id marks its group's rows
-        STATUS_UNKNOWN_PEER, neither poisoning the rest of the frame."""
+    def _op_vote_batch(
+        self, c: P.Cursor, prep: "_WireFramePrep | None" = None
+    ) -> tuple[int, bytes]:
+        """Coalesced columnar vote frame (``OP_VOTE_BATCH``), two paths:
+
+        - **columnar fast path** (default): the frame decodes to numpy
+          views (:func:`protocol.decode_vote_batch_views`), every vote
+          row parses strict-canonical into columns
+          (:mod:`bridge.columnar` — native, GIL-free when the runtime is
+          present), and each peer's rows land on
+          :meth:`TpuConsensusEngine.ingest_wire_columnar` — full
+          validation, zero per-vote Python objects. A pipelined
+          connection's reader thread hands in ``prep`` with the crypto
+          already in flight (the 3-stage wire pipeline: transport read,
+          verify-pool crypto, serial-lane device apply).
+        - **object path** (fallback + parity oracle): any row that is
+          malformed or non-canonical, or an engine without the columnar
+          entry point, sends the WHOLE frame through the per-vote
+          ``Vote.decode`` + ``ingest_votes_pipelined`` path — statuses
+          are byte-identical by construction (fuzz-asserted in
+          tests/test_wire_fuzz.py).
+
+        Per-vote statuses return in flattened batch order; an
+        undecodable blob marks its row 241 and an unknown peer_id marks
+        its group's rows STATUS_UNKNOWN_PEER, neither poisoning the
+        rest of the frame."""
+        if self._wire_columnar:
+            if prep is None:
+                fallback = c.fork()
+                prep = self._vote_batch_prepare(c)
+                if prep is None:
+                    c = fallback
+            if prep is not None and prep is not _PREP_FALLBACK:
+                return self._vote_batch_apply(prep)
+            self._m_wire_fallback.inc()
+        return self._op_vote_batch_objects(c)
+
+    def _op_vote_batch_objects(self, c: P.Cursor) -> tuple[int, bytes]:
+        """The object-path ``OP_VOTE_BATCH`` body: per-vote decode into
+        ``Vote`` objects, one pipelined engine dispatch per peer
+        (:meth:`TpuConsensusEngine.ingest_votes_pipelined` overlaps
+        group k+1's signature prepass with group k's apply)."""
         now, groups = P.decode_vote_batch(c)
         total = sum(len(votes) for _, _, votes in groups)
         statuses = bytearray([P.STATUS_BAD_REQUEST]) * total
@@ -959,6 +1230,176 @@ class BridgeServer:
             for row, code in zip(rows, codes):
                 statuses[row] = int(code) & 0xFF
         return P.STATUS_OK, P.u32(total) + bytes(statuses)
+
+    # ── Zero-copy columnar wire path ───────────────────────────────────
+
+    def _vote_batch_prepare(self, c: P.Cursor) -> "_WireFramePrep | None":
+        """Stage 1+2 of the wire pipeline, safe on the READER thread:
+        decode the frame to views, parse vote columns (native, GIL-free),
+        group rows per peer, and start each peer engine's session-
+        independent validation prepass — hash pass + ONE cache-aware
+        signature batch submit, running on the verify pool while earlier
+        frames still apply on the serial lane. Returns None when any row
+        is non-canonical (whole-frame object fallback) and raises the
+        object decoder's ``ValueError`` for structurally bad frames (the
+        wire contract stays identical). Peer resolution here is only a
+        prepass hint — the apply stage re-resolves in receive order, so
+        an ADD_PEER queued ahead of this frame still lands first."""
+        from . import columnar as WC
+
+        t0 = time.monotonic()
+        view = P.decode_vote_batch_views(c)
+        cols, flags = WC.parse_vote_columns(view.data, view.offsets)
+        if not bool(flags.all()):
+            return None
+        per_peer: list[dict] = []
+        by_peer: dict[int, dict] = {}
+        row = 0
+        for peer_id, scope, count in view.groups:
+            entry = by_peer.get(peer_id)
+            if entry is None:
+                entry = by_peer[peer_id] = {
+                    "peer_id": peer_id,
+                    "scopes": [],
+                    "scope_of": {},
+                    "rows": [],
+                    "sidx": [],
+                }
+                per_peer.append(entry)
+            k = entry["scope_of"].get(scope)
+            if k is None:
+                k = entry["scope_of"][scope] = len(entry["scopes"])
+                entry["scopes"].append(scope)
+            entry["rows"].extend(range(row, row + count))
+            entry["sidx"].extend([k] * count)
+            row += count
+        single = len(per_peer) == 1
+        for entry in per_peer:
+            rows = np.asarray(entry["rows"], np.int64)
+            entry["rows"] = rows
+            entry["sidx"] = np.asarray(entry["sidx"], np.int64)
+            if single:
+                entry["data"] = view.data
+                entry["offsets"] = view.offsets
+                entry["cols"] = cols
+            else:
+                entry["data"], entry["offsets"], entry["cols"] = (
+                    self._pack_rows(view, cols, rows)
+                )
+        self._m_wire_decode_s.inc(time.monotonic() - t0)
+        # Prepass start is CRYPTO time (hash pass + cache + batch
+        # submit), attributed separately from the wire decode above.
+        t1 = time.monotonic()
+        for entry in per_peer:
+            peer = self._peers.get(entry["peer_id"])
+            engine = None if peer is None else peer.engine
+            entry["engine"] = engine
+            entry["prepass"] = None
+            if (
+                engine is not None
+                and hasattr(engine, "ingest_wire_columnar")
+                and hasattr(engine, "wire_verify_begin")
+            ):
+                entry["prepass"] = engine.wire_verify_begin(
+                    entry["data"], entry["cols"], entry["offsets"]
+                )
+        self._m_wire_crypto_s.inc(time.monotonic() - t1)
+        return _WireFramePrep(view, per_peer)
+
+    @staticmethod
+    def _pack_rows(view, cols, rows: np.ndarray):
+        """Pack a peer's (possibly non-contiguous) rows into one
+        contiguous (data, offsets, cols) triple — vectorized gather, the
+        offset columns rebased. Multi-peer frames only; a single-peer
+        frame reuses the original views copy-free."""
+        from . import columnar as WC
+
+        starts = view.offsets[rows]
+        lens = view.offsets[rows + 1] - starts
+        offsets = np.zeros(len(rows) + 1, np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        total = int(offsets[-1])
+        gather = (
+            np.arange(total, dtype=np.int64)
+            - np.repeat(offsets[:-1], lens)
+            + np.repeat(starts, lens)
+        )
+        data = view.data[gather]
+        sub = cols[rows].copy()
+        delta = offsets[:-1] - starts
+        for col in (
+            WC.COL_OWNER_OFF, WC.COL_PARENT_OFF, WC.COL_RECV_OFF,
+            WC.COL_HASH_OFF, WC.COL_SIG_OFF,
+        ):
+            sub[:, col] += delta
+        return data, offsets, sub
+
+    def _vote_batch_apply(self, prep: "_WireFramePrep") -> tuple[int, bytes]:
+        """Stage 3 of the wire pipeline (serial lane, receive order):
+        re-resolve each peer and land its rows on
+        ``ingest_wire_columnar`` with the prepass the reader started —
+        the crypto has been running since. Unknown peers mark their rows
+        STATUS_UNKNOWN_PEER; an engine without the columnar entry point
+        (custom engine_factory) takes the object path for just its rows
+        — peers are independent, so statuses stay per-row exact."""
+        view = prep.view
+        statuses = bytearray(view.total)
+        out = np.frombuffer(statuses, np.uint8)
+        stage: dict = {}
+        for entry in prep.per_peer:
+            rows = entry["rows"]
+            peer = self._peers.get(entry["peer_id"])
+            if peer is None:
+                out[rows] = P.STATUS_UNKNOWN_PEER
+                continue
+            engine = peer.engine
+            if not hasattr(engine, "ingest_wire_columnar"):
+                self._apply_rows_objects(engine, entry, view, out)
+                continue
+            prepass = (
+                entry["prepass"] if engine is entry["engine"] else None
+            )
+            codes = engine.ingest_wire_columnar(
+                entry["scopes"],
+                entry["sidx"],
+                entry["cols"],
+                entry["data"],
+                entry["offsets"],
+                view.now,
+                stage_seconds=stage,
+                _prepass=prepass,
+            )
+            out[rows] = (np.asarray(codes, np.int64) & 0xFF).astype(np.uint8)
+        self._m_wire_columnar.inc()
+        self._m_wire_crypto_s.inc(stage.get("crypto", 0.0))
+        self._m_wire_apply_s.inc(stage.get("apply", 0.0))
+        return P.STATUS_OK, P.u32(view.total) + bytes(statuses)
+
+    def _apply_rows_objects(self, engine, entry, view, out) -> None:
+        """Object-path escape hatch for ONE peer's rows inside an
+        otherwise-columnar frame (engine_factory engines without the
+        columnar entry point). Rows are canonical by construction here,
+        so every blob decodes."""
+        from ..wire import Vote as _Vote
+
+        data_b = entry["data"].tobytes()
+        offsets = entry["offsets"]
+        scopes = entry["scopes"]
+        sidx = entry["sidx"]
+        batch = [
+            (
+                scopes[int(sidx[j])],
+                _Vote.decode(data_b[int(offsets[j]):int(offsets[j + 1])]),
+            )
+            for j in range(len(entry["rows"]))
+        ]
+        stages = [
+            batch[i:i + self._PIPELINE_SPLIT]
+            for i in range(0, len(batch), self._PIPELINE_SPLIT)
+        ]
+        results = engine.ingest_votes_pipelined(stages, view.now)
+        codes = [int(code) & 0xFF for stage in results for code in stage]
+        out[entry["rows"]] = np.asarray(codes, np.uint8)
 
     def _op_deliver_proposals(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
         """Anti-entropy delivery (``OP_DELIVER_PROPOSALS``): lands on

@@ -203,6 +203,26 @@ class SessionRecord(Generic[Scope]):
     # the two paths back into true (call-granularity) arrival order.
     arrival_seq: int = 0
     scalar_seqs: list[int] = field(default_factory=list)
+    # Wire-columnar chain continuity (ingest_wire_columnar): the
+    # session's effective tail hash and accepted-owner set as tracked by
+    # the validated wire path, plus a (retained chunks, scalar accepts)
+    # sync stamp. While the stamp matches the record, the dangling-vote
+    # guard keeps enforcing received-hash linkage across wire frames —
+    # without this, any frame after the first would be permissive and a
+    # dropped/reordered gossip stream could diverge peers. A mismatched
+    # stamp (legacy pre-validated columnar ingest, interleaved paths)
+    # falls back to the documented permissive behavior.
+    wire_tail: bytes | None = None
+    wire_seen: "set[bytes] | None" = None
+    wire_sync: "tuple[int, int] | None" = None
+    # True while EVERY retained chunk on this record came from the
+    # validated wire path (ingest_wire_columnar): its accepts are
+    # guard-ordered, so the merged retained+scalar chain stays
+    # positionally comparable — the anti-entropy watermark and the fork
+    # probe keep working on wire-fed sessions. The legacy pre-validated
+    # columnar ingest flips it False (arbitrary order; the documented
+    # permissive behavior).
+    wire_only: bool = True
     # Distributed trace identity bound at create/process time (None when
     # the trace store is disabled or the session arrived via an untraced
     # batch path): every later span/instant for this session joins this
@@ -229,6 +249,10 @@ class SessionRecord(Generic[Scope]):
         rec.retained_cache = None
         rec.arrival_seq = 0
         rec.scalar_seqs = []
+        rec.wire_tail = None
+        rec.wire_seen = None
+        rec.wire_sync = None
+        rec.wire_only = True
         rec.trace = None
         return rec
 
@@ -265,6 +289,37 @@ class PendingVoteVerdicts:
         self._result = None
 
     def collect(self) -> "tuple[list, list[bytes]]":
+        if self._collect_fn is not None:
+            self._result = self._collect_fn()
+            self._collect_fn = None
+        return self._result
+
+
+class WireVotePrepass:
+    """Handle for an in-flight wire-columnar validation prepass
+    (:meth:`TpuConsensusEngine.wire_verify_begin`): ``pre_status`` holds
+    the structural/hash verdicts already decided (0 = still live),
+    ``crypto_rows`` the row indices whose signatures were submitted, and
+    ``collect()`` blocks for their verdicts (idempotent). While
+    uncollected, the crypto runs on the native verify pool with no GIL
+    involvement — the bridge's reader thread starts the prepass for
+    frame k+1 while frame k's apply runs on the serial lane.
+
+    ``buf`` caches the frame's vote region as ``bytes`` when the prepass
+    sliced it for crypto, so the apply stage (and a durable wrapper's
+    WAL blob) reuse ONE copy instead of re-running ``tobytes()`` per
+    stage — the prepass and apply always see the same ``data`` array."""
+
+    __slots__ = ("pre_status", "crypto_rows", "buf", "_collect_fn", "_result")
+
+    def __init__(self, pre_status, crypto_rows, collect_fn, buf=None):
+        self.pre_status = pre_status
+        self.crypto_rows = crypto_rows
+        self.buf = buf
+        self._collect_fn = collect_fn
+        self._result = None
+
+    def collect(self) -> list:
         if self._collect_fn is not None:
             self._result = self._collect_fn()
             self._collect_fn = None
@@ -1306,12 +1361,20 @@ class TpuConsensusEngine(Generic[Scope]):
         themselves offline). A matching-but-shorter chain is a TRUNCATION:
         the chain's most recent signer — the closest accountable identity
         to the gossip source — is scored with the lag. Identical
-        redeliveries are benign and score nothing. Columnar-retained
-        sessions are skipped (merged order is not positionally
-        comparable, same reason _extension_suffix bails)."""
-        if not self._health_live or record.retained_wire:
+        redeliveries are benign and score nothing. Only LEGACY
+        (pre-validated) columnar retention is skipped — merged order not
+        positionally comparable, same reason _extension_suffix bails;
+        wire-validated retention probes against the merged chain, so a
+        forker cannot hide behind a victim's columnar ingest path."""
+        if not self._health_live or (
+            record.retained_wire and not record.wire_only
+        ):
             return
-        accepted = record.proposal.votes
+        accepted = (
+            self._accepted_vote_chain(record)
+            if record.retained_wire
+            else record.proposal.votes
+        )
         incoming = proposal.votes
         n = len(incoming)
         if n and n <= len(accepted):
@@ -1355,6 +1418,13 @@ class TpuConsensusEngine(Generic[Scope]):
                 prior = record.votes.get(theirs.vote_owner)
                 if prior is None and record.session is not None:
                     prior = record.session.votes.get(theirs.vote_owner)
+                if prior is None and record.retained_wire:
+                    # Wire-retained accepts live in the merged chain, not
+                    # the scalar vote map.
+                    for vote in accepted:
+                        if vote.vote_owner == theirs.vote_owner:
+                            prior = vote
+                            break
                 if prior is not None and prior.vote_hash != theirs.vote_hash:
                     # The retained pair is (offender's accepted vote,
                     # offender's divergent vote) — both carry the
@@ -1378,11 +1448,18 @@ class TpuConsensusEngine(Generic[Scope]):
         or None when the incoming chain is not a strict extension of it
         (shorter, equal-length, forked before the watermark, or the
         accepted chain is partly columnar-retained wire whose merged order
-        is not positionally comparable). The prefix compare is bytes
-        equality over already-validated hashes — no crypto."""
+        is not positionally comparable). Wire-validated retention
+        (``record.wire_only`` — the bridge's zero-copy OP_VOTE_BATCH
+        path) stays comparable: its accepts are guard-ordered, so the
+        merged chain is positional and anti-entropy can extend a
+        wire-fed session exactly as a scalar-fed one. The prefix compare
+        is bytes equality over already-validated hashes — no crypto."""
         if record.retained_wire:
-            return None
-        accepted = record.proposal.votes
+            if not record.wire_only:
+                return None
+            accepted = self._accepted_vote_chain(record)
+        else:
+            accepted = record.proposal.votes
         incoming = proposal.votes
         if len(incoming) <= len(accepted):
             return None
@@ -1475,12 +1552,17 @@ class TpuConsensusEngine(Generic[Scope]):
         """protocol.validate_vote_chain over accepted + suffix, checked
         from the watermark onward (``start``): the accepted prefix's links
         were validated at acceptance, and the chain rules live in exactly
-        one place. Returns a StatusCode int, 0 when valid."""
+        one place. Wire-retained records supply the MERGED accepted chain
+        (scalar votes alone would make a correctly-linked suffix look
+        dangling — its received_hash names the retained tail). Returns a
+        StatusCode int, 0 when valid."""
+        accepted = (
+            self._accepted_vote_chain(record)
+            if record.retained_wire
+            else record.proposal.votes
+        )
         try:
-            validate_vote_chain(
-                record.proposal.votes + suffix,
-                start=len(record.proposal.votes),
-            )
+            validate_vote_chain(accepted + suffix, start=len(accepted))
         except ConsensusError as exc:
             return int(exc.code)
         return 0
@@ -1747,9 +1829,26 @@ class TpuConsensusEngine(Generic[Scope]):
         identity = self._signer.identity()
         if identity in record.votes or (
             record.session is not None and identity in record.session.tallies
+        ) or (
+            record.retained_wire
+            and any(
+                identity == vote.vote_owner
+                for vote in self._accepted_vote_chain(record)
+            )
         ):
             raise UserAlreadyVoted()
-        vote = build_vote(record.proposal, choice, self._signer, now)
+        # Chain against the MERGED accepted chain: votes accepted through
+        # the zero-copy wire path live in retained wire chunks, not
+        # record.proposal.votes — linking against the scalar list alone
+        # would mint a vote whose received_hash ignores the real tail,
+        # and every other peer's dangling guard would (rightly) reject
+        # it. The materialized view is decode-cached per growth.
+        link_source = (
+            self._materialized_proposal(record)
+            if record.retained_wire
+            else record.proposal
+        )
+        vote = build_vote(link_source, choice, self._signer, now)
         statuses = self.ingest_votes(
             [(scope, vote)], now, pre_validated=True
         )
@@ -1954,8 +2053,25 @@ class TpuConsensusEngine(Generic[Scope]):
             # equivocations (known owners) keep their duplicate-shaped
             # statuses; empty links and columnar-retained sessions keep
             # the reference's permissive behavior.
-            first_time_voter = not record.retained_wire and (
+            # Wire-validated retention keeps the guard armed here too
+            # (continuity state maintained by ingest_wire_columnar): a
+            # session fed through the zero-copy bridge path and then hit
+            # by a scalar/object-path vote must guard exactly as if every
+            # vote had taken one path — otherwise the two paths' statuses
+            # could diverge on the same byte stream. Only LEGACY
+            # pre-validated columnar retention stays permissive.
+            wire_guarded = record.retained_wire and record.wire_only
+            if wire_guarded and (
+                record.wire_seen is None
+                or record.wire_sync
+                != (len(record.retained_wire), len(record.scalar_seqs))
+            ):
+                self._resync_wire_chain(record)
+            first_time_voter = not (
+                record.retained_wire and not record.wire_only
+            ) and (
                 vote.vote_owner not in record.votes
+                and not (wire_guarded and vote.vote_owner in record.wire_seen)
                 and (
                     record.session is None
                     or (
@@ -1971,7 +2087,9 @@ class TpuConsensusEngine(Generic[Scope]):
                     # head always carries an empty link).
                     tail = pending_tail.get(
                         slot,
-                        record.proposal.votes[-1].vote_hash
+                        (record.wire_tail or b"")
+                        if wire_guarded
+                        else record.proposal.votes[-1].vote_hash
                         if record.proposal.votes
                         else b"",
                     )
@@ -2354,14 +2472,18 @@ class TpuConsensusEngine(Generic[Scope]):
         max_depth: int,
         statuses: np.ndarray,
         wire_norm: "tuple[np.ndarray, np.ndarray] | None",
+        wire_validated: bool = False,
     ) -> np.ndarray:
         """Shared tail of the columnar paths: apply, then retain accepted
-        rows' wire bytes keyed by the resolved slots."""
+        rows' wire bytes keyed by the resolved slots. ``wire_validated``
+        marks retention coming from the guard-ordered wire path — the
+        only kind that keeps a record's chain positionally comparable
+        (SessionRecord.wire_only)."""
         statuses = self._columnar_apply(
             slots, found, voter_gids, values, now, max_depth, statuses
         )
         if wire_norm is not None:
-            self._retain_wire_slots(statuses, slots, wire_norm)
+            self._retain_wire_slots(statuses, slots, wire_norm, wire_validated)
         return statuses
 
     @staticmethod
@@ -2377,6 +2499,7 @@ class TpuConsensusEngine(Generic[Scope]):
         statuses: np.ndarray,
         slots: np.ndarray,
         wire_norm: tuple[np.ndarray, np.ndarray],
+        wire_validated: bool = False,
     ) -> None:
         """Attach accepted rows' verbatim vote bytes to their session
         records, keyed by the already-resolved slots (vectorized gather;
@@ -2429,6 +2552,7 @@ class TpuConsensusEngine(Generic[Scope]):
             hi_l = end_pos.tolist()
             for k, slot in enumerate(uniq.tolist()):
                 record = records[slot]
+                record.wire_only = record.wire_only and wire_validated
                 seq = record.arrival_seq
                 record.arrival_seq = seq + 1
                 record.retained_wire.append(
@@ -2453,6 +2577,7 @@ class TpuConsensusEngine(Generic[Scope]):
             seg_off = (out_off[lo : hi + 1] - out_off[lo]).copy()
             seg_blob = blob[int(out_off[lo]) : int(out_off[hi])].tobytes()
             record = self._records[int(slot)]
+            record.wire_only = record.wire_only and wire_validated
             record.retained_wire.append(
                 (record.next_arrival_seq(), seg_blob, seg_off)
             )
@@ -2486,6 +2611,18 @@ class TpuConsensusEngine(Generic[Scope]):
         wire_norm, statuses, done = self._columnar_preamble(batch, wire_votes)
         if done:
             return statuses
+        found, slots = self._resolve_slots_multi(scopes, scope_idx, proposal_ids)
+        return self._columnar_finish(
+            slots, found, voter_gids, values, now, max_depth, statuses,
+            wire_norm,
+        )
+
+    def _resolve_slots_multi(
+        self, scopes: list, scope_idx: np.ndarray, proposal_ids: np.ndarray
+    ) -> "tuple[np.ndarray, np.ndarray]":
+        """Mixed-scope proposal-id resolution shared by the columnar entry
+        points: (found bool[B], slots int64[B])."""
+        batch = len(proposal_ids)
         found = np.zeros(batch, bool)
         slots = np.zeros(batch, np.int64)
         fused = self._fused_pid_lookup(scopes)
@@ -2517,10 +2654,538 @@ class TpuConsensusEngine(Generic[Scope]):
                 )
                 found[rows] = hit
                 slots[rows] = hit_slots
-        return self._columnar_finish(
-            slots, found, voter_gids, values, now, max_depth, statuses,
-            wire_norm,
+        return found, slots
+
+    # ── Zero-copy wire ingest (OP_VOTE_BATCH columnar fast path) ───────
+
+    def wire_verify_begin(
+        self,
+        data: np.ndarray,
+        cols: np.ndarray,
+        offsets: np.ndarray,
+        buf: "bytes | None" = None,
+    ) -> "WireVotePrepass":
+        """Session-independent half of the wire-columnar validation:
+        structural emptiness checks, the batched vote-hash pass, and ONE
+        cache-aware signature batch submit over the survivors — all from
+        parsed columns (:mod:`hashgraph_tpu.bridge.columnar`), no Vote
+        objects anywhere. The crypto is in flight on the verify pool when
+        this returns, so a pipelined bridge connection submits frame
+        k+1's prepass while frame k applies (the 3-stage wire pipeline).
+        Safe to run before earlier queued frames apply because nothing
+        here reads session state — the same order-invariance contract as
+        :meth:`_vote_prepass_begin`, extended across session
+        registration (slot resolution happens at apply time, in receive
+        order, inside :meth:`ingest_wire_columnar`).
+
+        Check precedence mirrors ``validate_vote`` exactly: empty owner,
+        empty hash, empty signature, hash mismatch, then signature.
+        Replay/expiry need the session record and stay in
+        :meth:`ingest_wire_columnar`. ``offsets`` are the per-row spans
+        into ``data`` — the signing payload of a canonical row is the
+        PREFIX ``data[offsets[i] : offsets[i] + sign_len]``, so no
+        re-encode ever happens."""
+        from ..bridge import columnar as C
+
+        k = len(cols)
+        pre = np.zeros(k, np.int32)
+        owner_len = cols[:, C.COL_OWNER_LEN]
+        hash_len = cols[:, C.COL_HASH_LEN]
+        sig_len = cols[:, C.COL_SIG_LEN]
+        pre[owner_len == 0] = int(StatusCode.EMPTY_VOTE_OWNER)
+        pre[(pre == 0) & (hash_len == 0)] = int(StatusCode.EMPTY_VOTE_HASH)
+        pre[(pre == 0) & (sig_len == 0)] = int(StatusCode.EMPTY_SIGNATURE)
+        live = pre == 0
+        if live.any():
+            digests = C.vote_hash_columns(data, cols)
+            rows32 = np.nonzero(live & (hash_len == 32))[0]
+            if rows32.size:
+                gather = (
+                    cols[rows32, C.COL_HASH_OFF, None]
+                    + np.arange(32, dtype=np.int64)
+                )
+                mismatch = (data[gather] != digests[rows32]).any(axis=1)
+                pre[rows32[mismatch]] = int(StatusCode.INVALID_VOTE_HASH)
+            pre[live & (hash_len != 32)] = int(StatusCode.INVALID_VOTE_HASH)
+        crypto_rows = np.nonzero(pre == 0)[0]
+        if crypto_rows.size == 0:
+            return WireVotePrepass(pre, crypto_rows, lambda: [], buf=buf)
+        # Byte slices only for rows that reach crypto: one slice each for
+        # owner / payload / signature — no decode, no re-encode (the
+        # signing payload is a prefix of the canonical wire bytes).
+        if buf is None:
+            buf = data.tobytes()
+        base = np.asarray(offsets, np.int64)[crypto_rows].tolist()
+        row_l = cols[crypto_rows].tolist()
+        owners: list[bytes] = []
+        payloads: list[bytes] = []
+        sigs: list[bytes] = []
+        for start, c in zip(base, row_l):
+            owners.append(
+                buf[c[C.COL_OWNER_OFF]:c[C.COL_OWNER_OFF] + c[C.COL_OWNER_LEN]]
+            )
+            payloads.append(buf[start:start + c[C.COL_SIGN_LEN]])
+            sigs.append(
+                buf[c[C.COL_SIG_OFF]:c[C.COL_SIG_OFF] + c[C.COL_SIG_LEN]]
+            )
+        return WireVotePrepass(
+            pre,
+            crypto_rows,
+            self._wire_crypto_begin(owners, payloads, sigs),
+            buf=buf,
         )
+
+    def _wire_crypto_begin(self, owners, payloads, sigs):
+        """Cache-aware batched signature verify over byte triples (the
+        object path's :meth:`_cached_verify_begin` minus Vote objects):
+        dedups identical (payload, signature) items, consults the
+        admission cache, submits ONE scheme batch over the misses, and
+        returns a zero-arg collect -> verdicts aligned with the input."""
+        k = len(owners)
+        if self._verify_cache is None:
+            pending = self._scheme.verify_batch_submit(owners, payloads, sigs)
+
+            def _finish_uncached():
+                with observed_span(
+                    self.tracer, "engine.verify_batch", self._m_verify, votes=k
+                ):
+                    verdicts = pending.collect()
+                self._note_verified(k)
+                return list(verdicts)
+
+            return _finish_uncached
+        cache = self._verify_cache
+        verdicts: list = [False] * k
+        keys = [
+            VerifiedVoteCache.key(payload, sig, self._verify_scheme_tag)
+            for payload, sig in zip(payloads, sigs)
+        ]
+        miss_rows: dict[bytes, list[int]] = {}
+        for i, (key, hit) in enumerate(zip(keys, cache.get_many(keys))):
+            if hit is not MISS:
+                verdicts[i] = hit
+            else:
+                miss_rows.setdefault(key, []).append(i)
+        if not miss_rows:
+            return lambda: verdicts
+        rep = [rows[0] for rows in miss_rows.values()]
+        pending = self._scheme.verify_batch_submit(
+            [owners[i] for i in rep],
+            [payloads[i] for i in rep],
+            [sigs[i] for i in rep],
+        )
+
+        def _finish():
+            with observed_span(
+                self.tracer, "engine.verify_batch", self._m_verify,
+                votes=len(rep),
+            ):
+                fresh = pending.collect()
+            self._note_verified(len(rep))
+            for (_, rows), verdict in zip(miss_rows.items(), fresh):
+                for i in rows:
+                    verdicts[i] = verdict
+            cache.put_many(list(zip(miss_rows, fresh)))
+            return verdicts
+
+        return _finish
+
+    def ingest_wire_columnar(
+        self,
+        scopes: list,
+        scope_idx: np.ndarray,
+        cols: np.ndarray,
+        data: np.ndarray,
+        offsets: np.ndarray,
+        now: int,
+        max_depth: int = 8,
+        stage_seconds: "dict | None" = None,
+        _prepass: "WireVotePrepass | None" = None,
+        _buf: "bytes | None" = None,
+    ) -> np.ndarray:
+        """THE wire throughput path: fully *validated* mixed-scope ingest
+        straight from parsed ``OP_VOTE_BATCH`` columns — hash, signature
+        (batched, admission-cached), replay/expiry, and the dangling-vote
+        guard all run without constructing a single ``Vote`` object, then
+        the surviving rows land on the shared columnar apply pipeline
+        (:meth:`_columnar_finish`) with wire retention on.
+
+        Status-identical to ``ingest_votes`` (``pre_validated=False``)
+        over the same decoded rows — the bridge's object path remains the
+        parity oracle, property-tested in tests/test_wire_columnar.py and
+        fuzz-tested in tests/test_wire_fuzz.py; divergences that remain
+        (health admission granularity) are documented in PARITY.md.
+
+        ``cols``/``data``/``offsets`` come from
+        :func:`hashgraph_tpu.bridge.columnar.parse_vote_columns` over
+        canonical rows ONLY (callers fall back to the object path for
+        anything else). ``stage_seconds`` (optional dict) accumulates
+        ``"crypto"`` and ``"apply"`` wall seconds for the bench's stage
+        attribution. ``_prepass`` accepts a
+        :meth:`wire_verify_begin` started earlier (the pipelined bridge
+        starts it on the reader thread); default recomputes it inline.
+        ``_buf`` accepts the vote region already materialized as bytes
+        (a durable wrapper shares its WAL blob; the prepass's copy is
+        reused the same way) — one ``tobytes()`` per frame, not three."""
+        from ..bridge import columnar as C
+
+        scope_idx = np.asarray(scope_idx, np.int64)
+        offsets = np.asarray(offsets, np.int64)
+        batch = len(cols)
+        self.tracer.count("engine.votes_in", batch)
+        if batch:
+            self._m_votes_total.inc(batch)
+            self._m_batch_size.observe(batch)
+            flight_recorder.record("engine.ingest_wire_columnar", votes=batch)
+        statuses = np.full(batch, int(StatusCode.SESSION_NOT_FOUND), np.int32)
+        if batch == 0 and not self._multihost:
+            return statuses
+        pids = np.ascontiguousarray(cols[:, C.COL_PID])
+        found, slots = self._resolve_slots_multi(scopes, scope_idx, pids)
+        if self._multihost:
+            # Misrouted rows reject BEFORE validation (SESSION_NOT_FOUND),
+            # mirroring ingest_votes' precedence: the relay routes on this
+            # status and a misrouted-but-invalid vote must look the same
+            # as a misrouted-valid one.
+            lo, hi = self._pool.local_slots()
+            non_local = found & (slots >= 0) & ((slots < lo) | (slots >= hi))
+            found &= ~non_local
+        t0 = time.monotonic()
+        prepass = (
+            _prepass
+            if _prepass is not None
+            else self.wire_verify_begin(data, cols, offsets, buf=_buf)
+        )
+        buf = _buf if _buf is not None else prepass.buf
+        if buf is None:
+            buf = data.tobytes()
+        prepass.buf = buf
+        verdicts = prepass.collect()
+        pre = prepass.pre_status
+        valid = found.copy()
+        fail = found & (pre != 0)
+        statuses[fail] = pre[fail]
+        valid &= pre == 0
+        # Signature verdicts (validate_vote's injection semantics: an
+        # exception verdict carries its own status code).
+        sig_reject: list[tuple[int, int]] = []
+        for row, verdict in zip(prepass.crypto_rows.tolist(), verdicts):
+            if verdict is True:
+                continue
+            if isinstance(verdict, Exception):
+                code = int(getattr(verdict, "code", StatusCode.SIGNATURE_SCHEME))
+            else:
+                code = int(StatusCode.INVALID_VOTE_SIGNATURE)
+            sig_reject.append((row, code))
+        for row, code in sig_reject:
+            if valid[row]:
+                statuses[row] = code
+                valid[row] = False
+        if stage_seconds is not None:
+            stage_seconds["crypto"] = (
+                stage_seconds.get("crypto", 0.0) + time.monotonic() - t0
+            )
+        t1 = time.monotonic()
+        # Replay/expiry checks need the session record: per-UNIQUE-slot
+        # timestamp lookup, then one vectorized compare per rule.
+        ts_u64 = np.ascontiguousarray(cols[:, C.COL_TS]).view(np.uint64)
+        rows_v = np.nonzero(valid)[0]
+        admit_timeout = 0.0
+        if rows_v.size:
+            uniq = np.unique(slots[rows_v])
+            creation = np.empty(len(uniq), np.uint64)
+            expiry = np.empty(len(uniq), np.uint64)
+            for j, slot in enumerate(uniq.tolist()):
+                record = self._records[slot]
+                creation[j] = record.proposal.timestamp
+                expiry[j] = record.proposal.expiration_timestamp
+                if record.config.consensus_timeout > admit_timeout:
+                    admit_timeout = record.config.consensus_timeout
+            pos = np.searchsorted(uniq, slots[rows_v])
+            ts_rows = ts_u64[rows_v]
+            old = ts_rows < creation[pos]
+            expired = ~old & (
+                (ts_rows > expiry[pos]) | (np.uint64(now) > expiry[pos])
+            )
+            statuses[rows_v[old]] = int(
+                StatusCode.TIMESTAMP_OLDER_THAN_CREATION_TIME
+            )
+            statuses[rows_v[expired]] = int(StatusCode.VOTE_EXPIRED)
+            valid[rows_v[old | expired]] = False
+        self._wire_reject_health(buf, cols, found, statuses, now)
+        self._wire_dangling_guard(buf, cols, slots, valid, statuses)
+        # Voter interning: one gid per UNIQUE owner (vectorized when the
+        # scheme's identities are fixed-width — the common case), then the
+        # shared columnar apply with wire retention on.
+        gids = self._wire_intern_gids(buf, cols, valid)
+        values = cols[:, C.COL_VALUE] != 0
+        statuses = self._columnar_finish(
+            slots, valid, gids, values, now, max_depth, statuses,
+            (data, offsets), wire_validated=True,
+        )
+        self._wire_track_chain(buf, cols, slots, offsets, statuses)
+        self._wire_admit_health(
+            buf, cols, scopes, scope_idx, slots, offsets, statuses,
+            admit_timeout, now,
+        )
+        if stage_seconds is not None:
+            stage_seconds["apply"] = (
+                stage_seconds.get("apply", 0.0) + time.monotonic() - t1
+            )
+        return statuses
+
+    def _wire_reject_health(self, buf, cols, found, statuses, now) -> None:
+        """Scorecard attribution for wire-columnar validation rejects —
+        the vectorized twin of the object path's per-vote
+        ``_note_reject_health`` (same code set, same claimed-signer
+        attribution), sliced from the frame only on the failure path."""
+        if not self._health_live:
+            return
+        from ..bridge import columnar as C
+
+        sig_codes = (
+            int(StatusCode.INVALID_VOTE_SIGNATURE),
+            int(StatusCode.INVALID_VOTE_HASH),
+            int(StatusCode.SIGNATURE_SCHEME),
+        )
+        mask = found & (
+            (statuses == sig_codes[0])
+            | (statuses == sig_codes[1])
+            | (statuses == sig_codes[2])
+            | (statuses == int(StatusCode.VOTE_EXPIRED))
+        )
+        for row in np.nonzero(mask)[0].tolist():
+            c = cols[row]
+            owner = buf[c[C.COL_OWNER_OFF]:c[C.COL_OWNER_OFF] + c[C.COL_OWNER_LEN]]
+            if not owner:
+                continue
+            if int(statuses[row]) == int(StatusCode.VOTE_EXPIRED):
+                self.health.note_expired(owner, now)
+            else:
+                self.health.note_invalid_signature(owner, now)
+
+    def _wire_dangling_guard(self, buf, cols, slots, valid, statuses) -> None:
+        """The ingest_votes dangling-vote guard over columns: a
+        first-time voter whose received_hash does not name the session's
+        effective tail is rejected instead of appended — identical
+        semantics (including the optimistic in-batch tail walk) to the
+        object path on a fresh session. Unlike the legacy pre-validated
+        columnar path, the guard STAYS armed across wire frames: the
+        record's ``wire_tail``/``wire_seen`` continuity state (updated
+        from ground-truth accepted rows in :meth:`_wire_track_chain`)
+        carries the tail forward, so a dropped or reordered gossip frame
+        rejects its dangling followers on every peer the same way —
+        without this a storm could diverge peers into states anti-entropy
+        cannot reconcile. Sessions whose retained wire came from the
+        legacy permissive path (stale sync stamp) stay permissive, as
+        documented in PARITY.md."""
+        from ..bridge import columnar as C
+
+        rows = np.nonzero(valid)[0]
+        if rows.size == 0:
+            return
+        order = np.argsort(slots[rows], kind="stable")
+        prev_slot = -1
+        guard = False
+        tail = b""
+        seen: set = set()
+        for i in rows[order].tolist():
+            slot = int(slots[i])
+            if slot != prev_slot:
+                prev_slot = slot
+                record = self._records[slot]
+                if not record.retained_wire:
+                    guard = True
+                    tail = (
+                        record.proposal.votes[-1].vote_hash
+                        if record.proposal.votes
+                        else b""
+                    )
+                    seen = set(record.votes)
+                    if record.session is not None:
+                        seen.update(record.session.tallies)
+                        seen.update(record.session.votes)
+                elif record.wire_only:
+                    if record.wire_seen is None or record.wire_sync != (
+                        len(record.retained_wire), len(record.scalar_seqs)
+                    ):
+                        # Scalar accepts or a watermark extension landed
+                        # since the last wire frame: rebuild the
+                        # continuity state from the merged accepted
+                        # chain (decode is cached per growth).
+                        self._resync_wire_chain(record)
+                    guard = True
+                    tail = record.wire_tail or b""
+                    seen = set(record.wire_seen)
+                    if record.session is not None:
+                        seen.update(record.session.tallies)
+                        seen.update(record.session.votes)
+                else:
+                    guard = False
+            if not guard:
+                continue
+            c = cols[i]
+            owner = buf[c[C.COL_OWNER_OFF]:c[C.COL_OWNER_OFF] + c[C.COL_OWNER_LEN]]
+            if owner in seen:
+                continue
+            received = buf[c[C.COL_RECV_OFF]:c[C.COL_RECV_OFF] + c[C.COL_RECV_LEN]]
+            if received and received != tail:
+                statuses[i] = int(StatusCode.RECEIVED_HASH_MISMATCH)
+                valid[i] = False
+                self.tracer.count("engine.dangling_votes_rejected")
+                continue
+            tail = buf[c[C.COL_HASH_OFF]:c[C.COL_HASH_OFF] + c[C.COL_HASH_LEN]]
+            seen.add(owner)
+
+    def _accepted_vote_chain(self, record: "SessionRecord[Scope]") -> list:
+        """The session's accepted votes in true arrival order, retained
+        wire chunks and scalar accepts merged (no clones — callers read,
+        never mutate). For wire_only records this IS the positional
+        chain the watermark compares against."""
+        retained = self._decoded_retained(record)
+        scalar = record.proposal.votes
+        if not retained:
+            return scalar
+        n_pre = len(scalar) - len(record.scalar_seqs)
+        items: list[tuple[int, list]] = [(-1, scalar[:n_pre])] if n_pre else []
+        items.extend(
+            (seq, [vote])
+            for seq, vote in zip(record.scalar_seqs, scalar[n_pre:])
+        )
+        items.extend(retained)
+        items.sort(key=lambda t: t[0])
+        return [vote for _, votes in items for vote in votes]
+
+    def _resync_wire_chain(self, record: "SessionRecord[Scope]") -> None:
+        """Rebuild the wire-guard continuity state from the merged
+        accepted chain (after scalar accepts or a watermark extension
+        touched a wire_only record)."""
+        chain = self._accepted_vote_chain(record)
+        record.wire_seen = {vote.vote_owner for vote in chain}
+        record.wire_tail = chain[-1].vote_hash if chain else b""
+        record.wire_sync = (
+            len(record.retained_wire), len(record.scalar_seqs)
+        )
+
+    def _wire_track_chain(self, buf, cols, slots, offsets, statuses) -> None:
+        """Post-apply continuity update: fold each slot's ACCEPTED rows
+        (frame order) into the record's wire chain state — effective
+        tail hash, accepted-owner set, and the sync stamp that proves no
+        other path touched the record since."""
+        from ..bridge import columnar as C
+
+        ok_rows = np.nonzero(statuses == int(StatusCode.OK))[0]
+        if ok_rows.size == 0:
+            return
+        order = np.argsort(slots[ok_rows], kind="stable")
+        for i in ok_rows[order].tolist():
+            record = self._records[int(slots[i])]
+            if record.wire_seen is None:
+                record.wire_seen = set(record.votes)
+            c = cols[i]
+            record.wire_seen.add(
+                buf[c[C.COL_OWNER_OFF]:c[C.COL_OWNER_OFF] + c[C.COL_OWNER_LEN]]
+            )
+            record.wire_tail = (
+                buf[c[C.COL_HASH_OFF]:c[C.COL_HASH_OFF] + c[C.COL_HASH_LEN]]
+            )
+            record.wire_sync = (
+                len(record.retained_wire), len(record.scalar_seqs)
+            )
+
+    def _wire_intern_gids(self, buf, cols, valid) -> np.ndarray:
+        """gid column for the apply stage: unique owners interned once
+        each. Fixed-width identities (every real scheme) dedupe in one
+        vectorized np.unique over an [N, L] byte matrix; mixed widths
+        fall back to a memo dict."""
+        from ..bridge import columnar as C
+
+        batch = len(cols)
+        gids = np.zeros(batch, np.int64)
+        rows = np.nonzero(valid)[0]
+        if rows.size == 0:
+            return gids
+        lens = cols[rows, C.COL_OWNER_LEN]
+        width = int(lens[0])
+        if (lens == width).all():
+            data_arr = np.frombuffer(buf, np.uint8)
+            gather = (
+                cols[rows, C.COL_OWNER_OFF, None]
+                + np.arange(width, dtype=np.int64)
+            )
+            matrix = data_arr[gather]
+            uniq, inverse = np.unique(matrix, axis=0, return_inverse=True)
+            uniq_gids = np.array(
+                [self._pool.voter_gid(row.tobytes()) for row in uniq],
+                np.int64,
+            )
+            gids[rows] = uniq_gids[inverse.reshape(-1)]
+        else:
+            memo: dict[bytes, int] = {}
+            for i in rows.tolist():
+                c = cols[i]
+                owner = buf[c[C.COL_OWNER_OFF]:c[C.COL_OWNER_OFF] + c[C.COL_OWNER_LEN]]
+                gid = memo.get(owner)
+                if gid is None:
+                    gid = memo[owner] = self._pool.voter_gid(owner)
+                gids[i] = gid
+        return gids
+
+    def _wire_admit_health(
+        self, buf, cols, scopes, scope_idx, slots, offsets, statuses,
+        admit_timeout, now,
+    ) -> None:
+        """Post-apply health flush for the wire path: batched admission
+        counts for accepted rows (the object path's ``note_admitted``)
+        plus the equivocation probe over duplicate-shaped rejections —
+        a differing vote_hash from an owner the session already tallied
+        becomes a retained evidence pair, with the prior vote recovered
+        from the session's scalar votes or its retained wire chunks."""
+        if not self._health_live:
+            return
+        from ..bridge import columnar as C
+
+        ok = statuses == int(StatusCode.OK)
+        if ok.any():
+            admit_counts: dict[bytes, int] = {}
+            for row in np.nonzero(ok)[0].tolist():
+                c = cols[row]
+                owner = buf[c[C.COL_OWNER_OFF]:c[C.COL_OWNER_OFF] + c[C.COL_OWNER_LEN]]
+                admit_counts[owner] = admit_counts.get(owner, 0) + 1
+            self.health.note_admitted(
+                admit_counts, now, timeout_hint=admit_timeout
+            )
+        cand = statuses == self._EQUIVOCATION_PROBE_CODES[0]
+        for code in self._EQUIVOCATION_PROBE_CODES[1:]:
+            cand |= statuses == code
+        for row in np.nonzero(cand)[0].tolist():
+            slot = int(slots[row])
+            record = self._records[slot]
+            c = cols[row]
+            owner = buf[c[C.COL_OWNER_OFF]:c[C.COL_OWNER_OFF] + c[C.COL_OWNER_LEN]]
+            vote_hash = buf[c[C.COL_HASH_OFF]:c[C.COL_HASH_OFF] + c[C.COL_HASH_LEN]]
+            prior = record.votes.get(owner)
+            prior_bytes = None
+            if prior is not None and prior.vote_hash != vote_hash:
+                prior_bytes = prior.encode()
+            elif prior is None:
+                for _seq, chunk in self._decoded_retained(record):
+                    for v in chunk:
+                        if v.vote_owner == owner:
+                            if v.vote_hash != vote_hash:
+                                prior_bytes = v.encode()
+                            break
+                    if prior_bytes is not None:
+                        break
+            if prior_bytes is not None:
+                self.health.note_equivocation(
+                    scopes[int(scope_idx[row])],
+                    int(cols[row, C.COL_PID]),
+                    prior_bytes,
+                    buf[int(offsets[row]):int(offsets[row + 1])],
+                    owner,
+                    now,
+                )
 
     def _columnar_apply(
         self,
@@ -3870,6 +4535,7 @@ for _name in (
     "deliver_proposals",
     "ingest_columnar",
     "ingest_columnar_multi",
+    "ingest_wire_columnar",
     "voter_gid",
     "cast_vote",
     "cast_vote_and_get_proposal",

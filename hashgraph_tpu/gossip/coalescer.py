@@ -114,13 +114,17 @@ class VoteCoalescer:
             return window.votes if window is not None else 0
 
     def _seal(self, peer_name: str, window: _Window):
-        # Caller holds the lock.
+        # Caller holds the lock. The payload is a SEGMENT LIST (frame
+        # head + the buffered vote bytes objects, un-joined): the
+        # transport scatter-gathers it to the socket or shm ring, so the
+        # votes are never concatenated on the send side
+        # (protocol.encode_vote_batch_segments).
         del self._windows[peer_name]
         groups = [
             (peer_id, scope, votes)
             for (peer_id, scope), votes in window.groups.items()
         ]
         self._m_votes.inc(window.votes)
-        payload = P.encode_vote_batch(window.now, groups)
+        payload, _nbytes = P.encode_vote_batch_segments(window.now, groups)
         meta = [(peer_id, scope, len(votes)) for peer_id, scope, votes in groups]
         return payload, meta

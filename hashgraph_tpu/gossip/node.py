@@ -93,6 +93,7 @@ class GossipNode:
         escalate_sessions: int = 64,
         flusher: bool = False,
         catchup_factory=None,
+        shm_ring_bytes: int | None = None,
     ):
         self.name = name
         self._engine = engine
@@ -102,7 +103,14 @@ class GossipNode:
         # simulator injects one that rides its in-process fabric instead,
         # so the far-behind escalation path itself stays the live code.
         self._catchup_factory = catchup_factory
-        self._transport = transport if transport is not None else GossipTransport()
+        # shm_ring_bytes opts co-located peers into the shared-memory
+        # ring lane (loopback endpoints whose server grants
+        # FEATURE_SHM_RING); None keeps pure TCP.
+        self._transport = (
+            transport
+            if transport is not None
+            else GossipTransport(shm_ring_bytes=shm_ring_bytes)
+        )
         self._owns_transport = transport is None
         self._fanout = fanout
         self._rng = random.Random(seed)

@@ -28,6 +28,7 @@ per-request signal, never a silent hang.
 
 from __future__ import annotations
 
+import os
 import selectors
 import socket
 import threading
@@ -49,6 +50,16 @@ from ..obs import registry as default_registry
 
 _RECV_CHUNK = 256 * 1024
 
+# Most iovecs one sendmsg accepts (UIO_MAXIOV; EINVAL past it). A frame
+# coalesced from more vote segments than this is written in capped
+# scatter-gather passes via the partial-send resume path.
+try:  # pragma: no cover - platform probe
+    _IOV_MAX = os.sysconf("SC_IOV_MAX")
+    if _IOV_MAX <= 0:
+        _IOV_MAX = 1024
+except (AttributeError, ValueError, OSError):  # pragma: no cover
+    _IOV_MAX = 1024
+
 
 def _weak_sample(ref, method_name):
     """Gauge provider over a weakly-referenced transport (0 once dead)."""
@@ -63,7 +74,12 @@ def _weak_sample(ref, method_name):
 class PeerChannel:
     """One multiplexed connection to a peer's bridge server. Owned by a
     :class:`GossipTransport`; all socket I/O happens on the transport's
-    event-loop thread, callers only enqueue frames and await futures."""
+    event-loop thread, callers only enqueue frames and await futures.
+    Channels that negotiated ``FEATURE_SHM_RING`` additionally carry a
+    shared-memory ring pair (``shm_tx``/``shm_rx``): requests write
+    straight into the tx ring at enqueue time (one memcpy, no syscall)
+    and a per-channel reader thread completes futures from the rx ring;
+    the socket stays the control/fallback lane."""
 
     def __init__(self, name: str, sock: socket.socket, features: int,
                  max_inflight: int, max_queue_bytes: int):
@@ -77,20 +93,35 @@ class PeerChannel:
         self.error: Exception | None = None
         # Guarded by the channel lock: send queue + accounting. Frames
         # are fully encoded at enqueue time (the loop thread only moves
-        # bytes).
+        # bytes). Queue entries are (segments, nbytes, corr, future):
+        # segments lists ride to sendmsg un-joined (send-side zero-copy).
         self.lock = threading.Lock()
-        self.sendq: deque[tuple[bytes, Future]] = deque()
+        self.sendq: deque[tuple[list, int, int, Future]] = deque()
         self.queue_bytes = 0
         self.shed_total = 0
         # Loop-thread-only state: the frame currently being written and
         # the unanswered requests. Tagged channels match by correlation
         # id; untagged channels complete FIFO.
-        self.outbuf: memoryview | None = None
+        self.outbuf: "list[memoryview] | None" = None
         self.outfut: Future | None = None
+        self.outcorr = 0
         self.inflight: dict[int, Future] = {}
         self.fifo: deque[Future] = deque()
         self.next_corr = 0
         self.rbuf = bytearray()
+        # Shared-memory lane (None until an attach succeeds). shm
+        # futures are guarded by the channel lock (the rx thread and the
+        # kill path both touch them).
+        self.shm_tx = None
+        self.shm_rx = None
+        self.shm_inflight: dict[int, Future] = {}
+        self.shm_thread: "threading.Thread | None" = None
+        # Corr ids of MUTATING frames routed to the TCP lane (queued or
+        # awaiting response), guarded by the channel lock. While any are
+        # outstanding, later mutating frames also ride TCP so one
+        # ordered opcode stream never splits across lanes (the server
+        # serializes per lane, not across them).
+        self.tcp_mutating: set[int] = set()
 
     # ── accounting (any thread) ────────────────────────────────────────
 
@@ -104,9 +135,10 @@ class PeerChannel:
             return {
                 "alive": self.alive,
                 "pipelined": self.pipelined,
+                "shm": self.shm_tx is not None,
                 "queue_frames": len(self.sendq),
                 "queue_bytes": self.queue_bytes,
-                "inflight": self.inflight_count(),
+                "inflight": self.inflight_count() + len(self.shm_inflight),
                 "shed_total": self.shed_total,
             }
 
@@ -133,6 +165,7 @@ class GossipTransport:
         sndbuf: int | None = None,
         rcvbuf: int | None = None,
         reconnect: "ReconnectPolicy | None" = None,
+        shm_ring_bytes: int | None = None,
     ):
         self._max_inflight = max_inflight
         self._max_queue_bytes = max_queue_bytes
@@ -140,6 +173,11 @@ class GossipTransport:
         self._features = features
         self._sndbuf = sndbuf
         self._rcvbuf = rcvbuf
+        # Shared-memory rings for co-located peers: when set (ring bytes
+        # per direction) AND the server grants FEATURE_SHM_RING AND the
+        # endpoint is loopback, requests bypass the kernel socket path
+        # entirely (gossip.shm). Any attach failure silently keeps TCP.
+        self._shm_ring_bytes = shm_ring_bytes
         # Opt-in channel healing: when a peer's channel dies (and the
         # transport itself is not closing), re-dial it with capped
         # jittered backoff and a fresh HELLO. In-flight and queued
@@ -233,10 +271,17 @@ class GossipTransport:
         except BaseException:
             sock.close()
             raise
-        sock.setblocking(False)
         channel = PeerChannel(
             name, sock, features, self._max_inflight, self._max_queue_bytes
         )
+        if (
+            self._shm_ring_bytes
+            and features & P.FEATURE_SHM_RING
+            and features & P.FEATURE_PIPELINING
+            and host in ("127.0.0.1", "localhost", "::1")
+        ):
+            self._try_attach_shm(channel)  # still blocking; pre-loop
+        sock.setblocking(False)
         with self._lock:
             # Re-checked at registration time: a reconnect attempt's
             # blocking dial can race close() past the entry check, and a
@@ -268,10 +313,14 @@ class GossipTransport:
     # ── requests ───────────────────────────────────────────────────────
 
     def try_request(
-        self, name: str, opcode: int, payload: bytes = b""
+        self, name: str, opcode: int, payload: "bytes | list" = b""
     ) -> Future | None:
         """Enqueue one request for ``name``; None = shed (queue at its
-        byte cap — bounded backpressure, the caller repairs later)."""
+        byte cap / shm ring full — bounded backpressure, the caller
+        repairs later). ``payload`` may be a LIST of byte segments
+        (see :func:`bridge.protocol.encode_vote_batch_segments`): the
+        segments ride to ``sendmsg`` — or into the shm ring — without
+        ever being joined into one contiguous copy."""
         with self._lock:
             channel = self._channels.get(name)
         if channel is None:
@@ -283,13 +332,10 @@ class GossipTransport:
                 or BridgeConnectionLost(f"peer {name!r} disconnected")
             )
             return future
-        if channel.pipelined:
-            with channel.lock:
-                corr = channel.next_corr
-                channel.next_corr = (corr + 1) & 0xFFFFFFFF
-            frame = P.encode_tagged_frame(opcode, corr, payload)
+        if isinstance(payload, (bytes, bytearray, memoryview)):
+            psegs, pbytes = [payload], len(payload)
         else:
-            frame = P.encode_frame(opcode, payload)
+            psegs, pbytes = list(payload), sum(len(s) for s in payload)
         future = Future()
         with channel.lock:
             # Re-checked under the SAME lock _kill_channel drains the
@@ -302,7 +348,86 @@ class GossipTransport:
                     or BridgeConnectionLost(f"peer {name!r} disconnected")
                 )
                 return future
-            if channel.queue_bytes + len(frame) > channel.max_queue_bytes:
+            if channel.pipelined:
+                corr = channel.next_corr
+                channel.next_corr = (corr + 1) & 0xFFFFFFFF
+                header = P._TAGGED_HEADER.pack(5 + pbytes, opcode, corr)
+            else:
+                corr = 0
+                header = P._FRAME_HEADER.pack(1 + pbytes, opcode)
+            segments = [header, *psegs]
+            nbytes = len(header) + pbytes
+            mutating = opcode in P.MUTATING_OPCODES
+            if (
+                channel.shm_tx is not None
+                and nbytes <= channel.shm_tx.capacity
+                and not (mutating and channel.tcp_mutating)
+            ):
+                # Shared-memory lane: ONE memcpy into the ring, future
+                # completed by the rx thread. Ring full = the same shed
+                # signal as the byte cap (never split a stream across
+                # lanes — reordering a chained vote stream is worse
+                # than a deferred repair). A frame larger than the ring
+                # can EVER hold rides TCP below (shedding it would retry
+                # the same un-sendable frame forever), and while any
+                # mutating frame is on the TCP lane, later mutating
+                # frames follow it there — the server only preserves
+                # order WITHIN a lane, so admitting them to the ring
+                # would let them overtake the TCP frame.
+                try:
+                    written = channel.shm_tx.try_write(segments, nbytes)
+                except ValueError:  # ring closed under us: channel dying
+                    future.set_exception(
+                        channel.error
+                        or BridgeConnectionLost(f"peer {name!r} disconnected")
+                    )
+                    return future
+                if written:
+                    channel.shm_inflight[corr] = future
+                    self._m_sent.inc()
+                    return future
+                channel.shed_total += 1
+                self._m_shed.inc()
+                flight_recorder.record(
+                    "gossip.shed", peer=name, opcode=opcode, shm=True,
+                )
+                return None
+            if (
+                mutating
+                and channel.shm_tx is not None
+                and not channel.tcp_mutating
+            ):
+                # First mutating frame to leave the ring for TCP (it is
+                # oversize, or it arrives as the set drains to empty):
+                # admit it only once the server has consumed every frame
+                # already in the ring — earlier ring frames still queued
+                # could otherwise be APPLIED after this newer one (an
+                # older shorter chain landing late reads as truncation
+                # to the redelivery health probe). Shed until drained;
+                # the ring clears in microseconds and the caller's
+                # anti-entropy retry resends.
+                try:
+                    if channel.shm_tx.pending_bytes() > 0:
+                        channel.shed_total += 1
+                        self._m_shed.inc()
+                        flight_recorder.record(
+                            "gossip.shed", peer=name, opcode=opcode,
+                            shm=True, draining=True,
+                        )
+                        return None
+                except ValueError:  # ring closed under us: channel dying
+                    future.set_exception(
+                        channel.error
+                        or BridgeConnectionLost(f"peer {name!r} disconnected")
+                    )
+                    return future
+            # Byte cap applies only while frames are already queued: an
+            # empty queue always admits ONE frame (cap effectively
+            # cap + one frame), so a frame bigger than the cap itself
+            # degrades to serialized sends instead of shedding forever.
+            if channel.sendq and (
+                channel.queue_bytes + nbytes > channel.max_queue_bytes
+            ):
                 channel.shed_total += 1
                 self._m_shed.inc()
                 flight_recorder.record(
@@ -310,8 +435,10 @@ class GossipTransport:
                     queue_bytes=channel.queue_bytes,
                 )
                 return None
-            channel.sendq.append((frame, future))
-            channel.queue_bytes += len(frame)
+            channel.sendq.append((segments, nbytes, corr, future))
+            channel.queue_bytes += nbytes
+            if mutating and channel.pipelined:
+                channel.tcp_mutating.add(corr)
         self._wake()
         return future
 
@@ -395,22 +522,37 @@ class GossipTransport:
                 with channel.lock:
                     if not channel.sendq:
                         return
-                    frame, future = channel.sendq.popleft()
-                    channel.queue_bytes -= len(frame)
-                channel.outbuf = memoryview(frame)
+                    segments, nbytes, corr, future = channel.sendq.popleft()
+                    channel.queue_bytes -= nbytes
+                channel.outbuf = [memoryview(s) for s in segments]
                 channel.outfut = future
-            sent = channel.sock.send(channel.outbuf)
-            if sent < len(channel.outbuf):
-                channel.outbuf = channel.outbuf[sent:]
+                channel.outcorr = corr
+            # Scatter-gather write: the frame's segments (header + the
+            # coalescer's original vote bytes) go to the kernel in one
+            # syscall without ever being joined. Capped at IOV_MAX
+            # iovecs per call (sendmsg fails whole with EINVAL past it);
+            # the partial-send resume below picks up the remainder.
+            if hasattr(channel.sock, "sendmsg"):
+                sent = channel.sock.sendmsg(channel.outbuf[:_IOV_MAX])
+            else:  # pragma: no cover - platforms without sendmsg
+                sent = channel.sock.send(b"".join(channel.outbuf))
+            remaining: list[memoryview] = []
+            for seg in channel.outbuf:
+                if sent >= len(seg):
+                    sent -= len(seg)
+                    continue
+                remaining.append(seg[sent:] if sent else seg)
+                sent = 0
+            if remaining:
+                channel.outbuf = remaining
                 return  # kernel buffer full; resume on next writable
             # Frame fully handed to the kernel: it is now in flight.
-            frame_bytes = channel.outbuf.obj
             future = channel.outfut
+            corr = channel.outcorr
             channel.outbuf = None
             channel.outfut = None
             self._m_sent.inc()
             if channel.pipelined:
-                corr = P._U32.unpack_from(frame_bytes, 5)[0]
                 channel.inflight[corr] = future
             else:
                 channel.fifo.append(future)
@@ -420,26 +562,21 @@ class GossipTransport:
         if not chunk:
             raise ConnectionError("peer closed the connection")
         channel.rbuf += chunk
-        buf = channel.rbuf
-        pos = 0
-        while True:
-            if len(buf) - pos < 4:
-                break
-            (length,) = P._U32.unpack_from(buf, pos)
-            if length < 1 or length > P.MAX_FRAME:
-                raise ValueError(f"bad frame length {length}")
-            if len(buf) - pos < 4 + length:
-                break
-            body = bytes(buf[pos + 4 : pos + 4 + length])
-            pos += 4 + length
+        for body in P.split_frames(channel.rbuf):
             self._complete(channel, body)
-        if pos:
-            del buf[:pos]
 
     def _complete(self, channel: PeerChannel, body: bytes) -> None:
         status, corr, cursor = P.parse_frame(body, channel.pipelined)
         if channel.pipelined:
             future = channel.inflight.pop(corr, None)
+            with channel.lock:
+                channel.tcp_mutating.discard(corr)
+                if future is None:
+                    # A ring-sent request whose response outgrew the
+                    # ring comes back on the TCP control lane (corr ids
+                    # are shared across lanes; the server falls back
+                    # rather than wedge the response ring).
+                    future = channel.shm_inflight.pop(corr, None)
         else:
             future = channel.fifo.popleft() if channel.fifo else None
         if future is None:
@@ -470,9 +607,18 @@ class GossipTransport:
         except OSError:
             pass
         with channel.lock:
-            queued = [future for _, future in channel.sendq]
+            queued = [entry[3] for entry in channel.sendq]
             channel.sendq.clear()
             channel.queue_bytes = 0
+            queued.extend(channel.shm_inflight.values())
+            channel.shm_inflight.clear()
+            channel.tcp_mutating.clear()
+            shm_rings = (channel.shm_tx, channel.shm_rx)
+            channel.shm_tx = None
+            channel.shm_rx = None
+        for ring in shm_rings:
+            if ring is not None:
+                ring.close()
         pending = list(channel.inflight.values()) + list(channel.fifo)
         channel.inflight.clear()
         channel.fifo.clear()
@@ -490,6 +636,96 @@ class GossipTransport:
                 future.set_exception(error)
         if record and self._running:
             self._maybe_reconnect(channel.name)
+
+    # ── shared-memory lane ─────────────────────────────────────────────
+
+    def _try_attach_shm(self, channel: PeerChannel) -> None:
+        """Create a ring pair and offer it to the server (blocking; runs
+        during connect, before the socket joins the event loop). Any
+        failure keeps the TCP lane silently — old servers, containers
+        without a shared /dev/shm, and platform gaps all degrade to
+        exactly the pre-shm behavior."""
+        tx = rx = None
+        try:
+            from .shm import ShmRing, shm_available
+
+            if not shm_available():
+                return
+            tx = ShmRing.create(self._shm_ring_bytes)  # client -> server
+            rx = ShmRing.create(self._shm_ring_bytes)  # server -> client
+            with channel.lock:
+                corr = channel.next_corr
+                channel.next_corr = (corr + 1) & 0xFFFFFFFF
+            channel.sock.sendall(P.encode_tagged_frame(
+                P.OP_SHM_ATTACH,
+                corr,
+                P.u32(self._shm_ring_bytes)
+                + P.string(tx.name)
+                + P.string(rx.name),
+            ))
+            status, _rcorr, _cursor = P.read_tagged_frame(channel.sock)
+            if status != P.STATUS_OK:
+                raise ValueError(f"shm attach refused (status {status})")
+        except (OSError, ValueError, RuntimeError, ConnectionError):
+            for ring in (tx, rx):
+                if ring is not None:
+                    ring.close()
+            return
+        channel.shm_tx = tx
+        channel.shm_rx = rx
+        channel.shm_thread = threading.Thread(
+            target=self._shm_rx_loop, args=(channel,), daemon=True,
+            name=f"gossip-shm-{channel.name}",
+        )
+        channel.shm_thread.start()
+        flight_recorder.record("gossip.shm_attached", peer=channel.name)
+
+    def _shm_rx_loop(self, channel: PeerChannel) -> None:
+        """Per-channel response drain for the shm lane: the ring carries
+        the same tagged frame stream as the socket; futures complete by
+        correlation id."""
+        from .shm import ShmSpin
+
+        spin = ShmSpin()
+        buf = bytearray()
+        while channel.alive and self._running:
+            rx = channel.shm_rx
+            if rx is None:
+                return
+            try:
+                chunk = rx.read_available()
+            except (OSError, ValueError):
+                return  # ring closed/unmapped under us (channel died)
+            if chunk is None:
+                spin.wait()
+                continue
+            spin.hit()
+            buf += chunk
+            try:
+                frames = P.split_frames(buf, min_len=5)
+            except ValueError:
+                self._kill_channel(channel, BridgeConnectionLost(
+                    f"peer {channel.name!r} shm stream corrupt"
+                ))
+                return
+            for body in frames:
+                self._complete_shm(channel, body)
+
+    def _complete_shm(self, channel: PeerChannel, body: bytes) -> None:
+        status, corr, cursor = P.parse_frame(body, tagged=True)
+        with channel.lock:
+            future = channel.shm_inflight.pop(corr, None)
+        if future is None:
+            return
+        if status == P.STATUS_OK:
+            future.set_result(cursor)
+        else:
+            message = ""
+            try:
+                message = cursor.string()
+            except ValueError:
+                pass
+            future.set_exception(BridgeError(status, message))
 
     def _maybe_reconnect(self, name: str) -> None:
         """Spawn (at most one per peer) the bounded backoff re-dial loop,

@@ -97,7 +97,7 @@ def _load() -> ctypes.CDLL | None:
             lib = ctypes.CDLL(path)
         except OSError:
             return None
-        _NEWEST_SYMBOL = "hg_ed25519_verify_batch_submit"  # bump when the ABI grows
+        _NEWEST_SYMBOL = "hg_parse_vote_columns"  # bump when the ABI grows
         if not hasattr(lib, _NEWEST_SYMBOL):
             # Stale artifact (e.g. a cached build from an older checkout):
             # rebuild the default path once, else give up.
@@ -162,7 +162,14 @@ def _load() -> ctypes.CDLL | None:
         lib.hg_ed25519_verify_batch_submit.argtypes = [
             u8p, u8p, u64p, u8p, ctypes.c_int64, u8p,
         ]
-        if lib.hg_version() < 3:
+        # Columnar wire parse (v4 ABI).
+        lib.hg_parse_vote_columns.argtypes = [
+            u8p, u64p, ctypes.c_int64, i64p, u8p, ctypes.c_int,
+        ]
+        lib.hg_vote_hash_columns.argtypes = [
+            u8p, i64p, ctypes.c_int64, u8p, ctypes.c_int,
+        ]
+        if lib.hg_version() < 4:
             return None
         _lib = lib
         return _lib
@@ -525,6 +532,69 @@ def ed25519_verify_batch(
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         _np_u8p(sigs),
         k,
+        _np_u8p(out),
+        n_threads,
+    )
+    return out
+
+
+# ── Columnar wire-vote parsing ─────────────────────────────────────────
+
+VOTE_COLS = 16  # int64 columns per parsed vote (see consensus_native.cpp)
+
+
+def parse_vote_columns(
+    data: np.ndarray, offsets: np.ndarray, n_threads: int = 0
+) -> "tuple[np.ndarray, np.ndarray] | None":
+    """Strict-canonical batched Vote parse straight off the wire buffer:
+    returns (cols int64[N, VOTE_COLS], flags uint8[N]) — flag 1 rows are
+    canonical and fully columnized, flag 0 rows need the Python object
+    decoder. None when the native runtime is absent. GIL-free."""
+    lib = _load()
+    if lib is None:
+        return None
+    d = (
+        data
+        if isinstance(data, np.ndarray) and data.dtype == np.uint8
+        and data.flags.c_contiguous
+        else np.ascontiguousarray(np.frombuffer(bytes(data), np.uint8))
+    )
+    offs = np.ascontiguousarray(offsets, np.uint64)
+    n = len(offs) - 1
+    cols = np.zeros((n, VOTE_COLS), np.int64)
+    flags = np.zeros(n, np.uint8)
+    lib.hg_parse_vote_columns(
+        _np_u8p(d),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        n,
+        cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        _np_u8p(flags),
+        n_threads,
+    )
+    return cols, flags
+
+
+def vote_hash_columns(
+    data: np.ndarray, cols: np.ndarray, n_threads: int = 0
+) -> "np.ndarray | None":
+    """Batched ``protocol.compute_vote_hash`` over parsed columns:
+    uint8[N, 32] digests, or None when the runtime is absent."""
+    lib = _load()
+    if lib is None:
+        return None
+    d = (
+        data
+        if isinstance(data, np.ndarray) and data.dtype == np.uint8
+        and data.flags.c_contiguous
+        else np.ascontiguousarray(np.frombuffer(bytes(data), np.uint8))
+    )
+    c = np.ascontiguousarray(cols, np.int64)
+    n = len(c)
+    out = np.empty((n, 32), np.uint8)
+    lib.hg_vote_hash_columns(
+        _np_u8p(d),
+        c.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
         _np_u8p(out),
         n_threads,
     )

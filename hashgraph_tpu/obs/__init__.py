@@ -194,6 +194,18 @@ GOSSIP_ANTI_ENTROPY_SESSIONS_TOTAL = (
 )
 GOSSIP_CATCHUP_ESCALATIONS_TOTAL = "hashgraph_gossip_catchup_escalations_total"
 
+# Zero-copy wire ingest (bridge._op_vote_batch columnar fast path):
+# frames taken by each path, shm ring attachments, and per-stage wall
+# seconds (wire decode / crypto / device apply) — the attribution the
+# gossip bench reads back over GET_METRICS so the residual gap between
+# networked and in-process throughput stays explainable per stage.
+WIRE_COLUMNAR_FRAMES_TOTAL = "hashgraph_bridge_wire_columnar_frames_total"
+WIRE_FALLBACK_FRAMES_TOTAL = "hashgraph_bridge_wire_fallback_frames_total"
+WIRE_DECODE_SECONDS_TOTAL = "hashgraph_bridge_wire_decode_seconds_total"
+WIRE_CRYPTO_SECONDS_TOTAL = "hashgraph_bridge_wire_crypto_seconds_total"
+WIRE_APPLY_SECONDS_TOTAL = "hashgraph_bridge_wire_apply_seconds_total"
+SHM_RINGS_ATTACHED_TOTAL = "hashgraph_bridge_shm_rings_attached_total"
+
 # Process-wide default registry (mirrors tracing.tracer's role).
 registry = MetricsRegistry()
 
@@ -262,6 +274,12 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         GOSSIP_ANTI_ENTROPY_ROUNDS_TOTAL,
         GOSSIP_ANTI_ENTROPY_SESSIONS_TOTAL,
         GOSSIP_CATCHUP_ESCALATIONS_TOTAL,
+        WIRE_COLUMNAR_FRAMES_TOTAL,
+        WIRE_FALLBACK_FRAMES_TOTAL,
+        WIRE_DECODE_SECONDS_TOTAL,
+        WIRE_CRYPTO_SECONDS_TOTAL,
+        WIRE_APPLY_SECONDS_TOTAL,
+        SHM_RINGS_ATTACHED_TOTAL,
     ):
         reg.counter(name)
     reg.info(BUILD_INFO).set(

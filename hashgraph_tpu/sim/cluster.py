@@ -102,6 +102,7 @@ class SimPeer:
             wal_fsync="batch",
             signer_factory=cluster.signer_factory,
             health_monitor=self.monitor,
+            wire_columnar=cluster.wire_columnar,
         )
         self.server.start_embedded()
         status, out = self.server.dispatch_frame(
@@ -236,6 +237,7 @@ class SimCluster:
         escalate_sessions: int = 8,
         signer_factory: type = StubConsensusSigner,
         base_delay: int = 1,
+        wire_columnar: "bool | None" = None,
     ):
         self.root = root
         self.seed = seed
@@ -245,6 +247,11 @@ class SimCluster:
         self.voter_capacity = voter_capacity
         self.escalate_sessions = escalate_sessions
         self.signer_factory = signer_factory
+        # Per-cluster override of the bridge's columnar wire path (None =
+        # the server's env-driven default): scenario runs must be pure
+        # functions of their arguments, and the columnar-wire scenario
+        # pins this True so the env cannot change what it asserts.
+        self.wire_columnar = wire_columnar
         self.scheduler = SimScheduler(seed)
         self.network = SimNetwork(self.scheduler, base_delay=base_delay)
         # The CONSENSUS clock: the logical `now` every engine call gets.

@@ -21,6 +21,8 @@ The corpus (≥ the ISSUE's eight):
 - ``equivocator``           — signed double-voting, faulty + verified evidence
 - ``forker``                — divergent chain delivery, fork evidence
 - ``expired-spam-burst``    — expired gossip + in-flight signature corruption
+- ``columnar-wire-storm``   — mutated OP_VOTE_BATCH frames convicted by the
+  COLUMNAR wire validator (zero-copy server path, wire_columnar pinned on)
 - ``timeout-liveness``      — embedder timeouts decide identically everywhere
 
 A corpus run can also prove the harness is not blind to itself:
@@ -280,6 +282,63 @@ def _expired_spam_burst(c: SimCluster):
     }, {"culprit": culprit, "mutated_frames": c.network.stats.mutated}
 
 
+def _columnar_wire_storm(c: SimCluster):
+    """OP_VOTE_BATCH frames through the COLUMNAR server path with link
+    mutation armed: the byte-mutation injector's corrupted signatures
+    must be convicted by the columnar validator (native parser or its
+    Python twin — the cluster pins wire_columnar=True), not the object
+    path, and all three verdicts must still hold."""
+    from ..obs import WIRE_COLUMNAR_FRAMES_TOTAL, WIRE_FALLBACK_FRAMES_TOTAL
+    from ..obs import registry as _registry
+
+    frames0 = _registry.counter(WIRE_COLUMNAR_FRAMES_TOTAL).value
+    fallback0 = _registry.counter(WIRE_FALLBACK_FRAMES_TOTAL).value
+    byz = ByzantineActor(c)
+    pre = c.create_session(c.peer(0), "pre")
+    c.vote_all(pre)
+    live = c.create_session(c.peer(0), "live")
+    for i in (0, 1):
+        c.cast_vote(live, c.peer(i), True)
+    byz.arm_frame_mutation()
+    byz.signature_burst(live, count=5)
+    culprit = byz.identity.hex()
+    cards = [
+        peer.monitor.scorecard(byz.identity) or {} for peer in c.live_peers()
+    ]
+    burst_alert = all(
+        any(
+            alert["rule"] == "invalid-signature-burst"
+            for alert in peer.monitor.evaluate_alerts(now=c.now)
+        )
+        for peer in c.live_peers()
+    )
+    for i in (2, 3):
+        c.cast_vote(live, c.peer(i), True)
+    columnar = (
+        _registry.counter(WIRE_COLUMNAR_FRAMES_TOTAL).value - frames0
+    )
+    fallback = (
+        _registry.counter(WIRE_FALLBACK_FRAMES_TOTAL).value - fallback0
+    )
+    return {culprit: GRADE_SUSPECT}, {
+        # The point of the scenario: the mutated frames went through the
+        # columnar decode+validate path (mutated signatures stay
+        # canonical bytes, so nothing should have fallen back), and the
+        # rejects were scored against the claimed signer.
+        "columnar_path_decoded_frames": columnar > 0,
+        "no_object_path_fallbacks": fallback == 0,
+        "frames_mutated": c.network.stats.mutated > 0,
+        "invalid_signatures_scored": all(
+            card.get("invalid_signatures", 0) >= 4 for card in cards
+        ),
+        "signature_burst_alert": burst_alert,
+    }, {
+        "culprit": culprit,
+        "columnar_frames": columnar,
+        "mutated_frames": c.network.stats.mutated,
+    }
+
+
 def _timeout_liveness(c: SimCluster):
     # expected_voters past the live peer count: the session can only
     # decide through the embedder's timeout duty.
@@ -320,6 +379,10 @@ SCENARIOS: "dict[str, _Spec]" = {
     "equivocator": _Spec(_equivocator),
     "forker": _Spec(_forker),
     "expired-spam-burst": _Spec(_expired_spam_burst),
+    # wire_columnar pinned True: the scenario asserts the columnar wire
+    # path itself, so the HASHGRAPH_TPU_WIRE_COLUMNAR env override must
+    # not be able to change what it measures.
+    "columnar-wire-storm": _Spec(_columnar_wire_storm, wire_columnar=True),
     "timeout-liveness": _Spec(_timeout_liveness),
 }
 

@@ -311,11 +311,17 @@ class SimTransport:
         return {name: ch.stats() for name, ch in self._channels.items()}
 
     def try_request(
-        self, name: str, opcode: int, payload: bytes = b""
+        self, name: str, opcode: int, payload: "bytes | list" = b""
     ) -> "SimFuture | None":
         channel = self._channels.get(name)
         if channel is None:
             raise KeyError(f"unknown peer {name!r}")
+        if not isinstance(payload, (bytes, bytearray, memoryview)):
+            # Segment lists (send-side zero-copy on the real transport)
+            # join here: the sim ships whole payloads through its
+            # virtual-time network, and the mutation/digest hooks want
+            # one contiguous byte string.
+            payload = b"".join(payload)
         future = SimFuture(self._network.scheduler)
         if not channel.alive:
             future.set_exception(

@@ -101,12 +101,16 @@ class DurableEngine:
         if chunk:
             self._wal.append(kind, encode(chunk))
 
-    def _append_columnar_split(self, now, scopes, scope_idx, blob, offsets) -> None:
+    def _append_columnar_split(
+        self, now, scopes, scope_idx, blob, offsets, kind=None
+    ) -> None:
         """Columnar counterpart of :meth:`_append_split`: chunk the ROW
         range by walking the offsets (per-row footprint = wire bytes + one
         u32 offset entry + one u32 scope_idx entry when multi-scope),
         rebasing offsets and slicing scope_idx per chunk. Each chunk keeps
         the full scope list — only the rows are split."""
+        if kind is None:
+            kind = F.KIND_COLUMNAR
         multi = len(scopes) > 1
         # Fixed per-record lead: now + scope count + scopes + row count +
         # blob length prefix + the offsets array's extra (rows+1)th entry.
@@ -125,7 +129,7 @@ class DurableEngine:
                 end += 1
             lo, hi = int(offsets[start]), int(offsets[end])
             self._wal.append(
-                F.KIND_COLUMNAR,
+                kind,
                 F.encode_columnar(
                     now,
                     scopes,
@@ -137,7 +141,7 @@ class DurableEngine:
             start = end
 
     def _log_columnar_accepted(
-        self, now, scopes, scope_idx, blob, offsets, statuses
+        self, now, scopes, scope_idx, blob, offsets, statuses, kind=None
     ) -> None:
         """Log the rows the engine ACCEPTED (status OK) out of an applied
         columnar batch. Columnar records are logged after the apply, before
@@ -152,7 +156,9 @@ class DurableEngine:
         if not ok.any():
             return
         if ok.all():
-            self._append_columnar_split(now, scopes, scope_idx, blob, offsets)
+            self._append_columnar_split(
+                now, scopes, scope_idx, blob, offsets, kind=kind
+            )
             return
         keep = np.flatnonzero(ok)
         lens = (offsets[1:] - offsets[:-1])[keep]
@@ -162,7 +168,9 @@ class DurableEngine:
             blob[int(offsets[i]) : int(offsets[i + 1])] for i in keep
         )
         idx = None if scope_idx is None else np.asarray(scope_idx)[keep]
-        self._append_columnar_split(now, scopes, idx, new_blob, new_offsets)
+        self._append_columnar_split(
+            now, scopes, idx, new_blob, new_offsets, kind=kind
+        )
 
     # ── Accessors ──────────────────────────────────────────────────────
 
@@ -563,6 +571,54 @@ class DurableEngine:
             )
             self._log_columnar_accepted(
                 now, list(scopes), idx, blob, offsets, statuses
+            )
+            return statuses
+
+    def ingest_wire_columnar(
+        self,
+        scopes,
+        scope_idx,
+        cols,
+        data,
+        offsets,
+        now,
+        max_depth=8,
+        stage_seconds=None,
+        _prepass=None,
+    ):
+        """Durable wire-columnar ingest (the bridge's OP_VOTE_BATCH fast
+        path): apply-validated rows log as a KIND_WIRE_COLUMNAR record of
+        their verbatim wire bytes — same accepted-rows-only discipline as
+        :meth:`ingest_columnar_multi`, logged after the apply, before the
+        ack, but the kind byte routes replay back through
+        ``ingest_wire_columnar`` (crypto skipped) so a recovered peer
+        keeps wire-validated retention and the cross-frame dangling-vote
+        guard its non-crashed twins have (see format.KIND_WIRE_COLUMNAR).
+        The WAL blob doubles as the engine's working copy (``_buf``) —
+        one ``tobytes()`` per frame across the whole durable path."""
+        with self._lock:
+            blob = (
+                _prepass.buf if _prepass is not None and _prepass.buf is not None
+                else data.tobytes() if hasattr(data, "tobytes")
+                else bytes(data)
+            )
+            statuses = self._engine.ingest_wire_columnar(
+                scopes,
+                scope_idx,
+                cols,
+                data,
+                offsets,
+                now,
+                max_depth=max_depth,
+                stage_seconds=stage_seconds,
+                _prepass=_prepass,
+                _buf=blob,
+            )
+            offs = np.asarray(offsets, np.int64)
+            idx = None if len(scopes) <= 1 else np.asarray(scope_idx)
+            self._log_columnar_accepted(
+                now, list(scopes), idx, blob, offs, statuses,
+                kind=F.KIND_WIRE_COLUMNAR,
             )
             return statuses
 

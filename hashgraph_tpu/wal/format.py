@@ -50,6 +50,14 @@ KIND_SNAPSHOT = 8  # snapshot watermark: records with lsn <= mark are covered
 # vs ingest (redelivery rejects) — replay must re-run the call that was
 # acked, not a lookalike.
 KIND_DELIVER = 9
+# Wire-columnar ingest (engine.ingest_wire_columnar). Payload is the
+# KIND_COLUMNAR encoding verbatim; the kind byte alone routes replay back
+# through the wire path (crypto skipped — only accepted rows are logged),
+# because the wire path RETAINS its chains wire-validated: replaying
+# through plain columnar ingest would demote ``wire_only`` and a
+# recovered peer would silently drop the cross-frame dangling-vote guard
+# its non-crashed twins keep.
+KIND_WIRE_COLUMNAR = 10
 
 KIND_NAMES = {
     KIND_PROPOSALS: "proposals",
@@ -61,6 +69,7 @@ KIND_NAMES = {
     KIND_SWEEP: "sweep",
     KIND_SNAPSHOT: "snapshot",
     KIND_DELIVER: "deliver",
+    KIND_WIRE_COLUMNAR: "wire_columnar",
 }
 
 # Scope-config record modes (the engine has three distinct mutation

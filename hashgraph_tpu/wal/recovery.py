@@ -284,6 +284,29 @@ def read_tail(
     return records, False
 
 
+def _replay_columnar(engine, now, scopes, scope_idx, blob, offsets) -> None:
+    """Re-apply a columnar record through the pre-validated columnar
+    ingest, re-deriving gids from the wire bytes (fresh interning)."""
+    votes = [
+        Vote.decode(blob[offsets[i] : offsets[i + 1]])
+        for i in range(len(offsets) - 1)
+    ]
+    pids = np.fromiter((v.proposal_id for v in votes), np.int64, len(votes))
+    gids = np.fromiter(
+        (engine.voter_gid(v.vote_owner) for v in votes), np.int64, len(votes)
+    )
+    values = np.fromiter((v.vote for v in votes), bool, len(votes))
+    if len(scopes) > 1:
+        engine.ingest_columnar_multi(
+            scopes, scope_idx, pids, gids, values, now,
+            wire_votes=(blob, offsets),
+        )
+    else:
+        engine.ingest_columnar(
+            scopes[0], pids, gids, values, now, wire_votes=(blob, offsets)
+        )
+
+
 def _apply(engine, kind: int, payload: bytes, stats: ReplayStats) -> None:
     if kind == F.KIND_PROPOSALS:
         now, items = F.decode_proposals(payload)
@@ -307,27 +330,43 @@ def _apply(engine, kind: int, payload: bytes, stats: ReplayStats) -> None:
         stats.votes_replayed += len(decoded)
     elif kind == F.KIND_COLUMNAR:
         now, scopes, scope_idx, blob, offsets = F.decode_columnar(payload)
-        votes = [
-            Vote.decode(blob[offsets[i] : offsets[i + 1]])
-            for i in range(len(offsets) - 1)
-        ]
-        pids = np.fromiter(
-            (v.proposal_id for v in votes), np.int64, len(votes)
-        )
-        gids = np.fromiter(
-            (engine.voter_gid(v.vote_owner) for v in votes), np.int64, len(votes)
-        )
-        values = np.fromiter((v.vote for v in votes), bool, len(votes))
-        if len(scopes) > 1:
-            engine.ingest_columnar_multi(
-                scopes, scope_idx, pids, gids, values, now,
-                wire_votes=(blob, offsets),
+        _replay_columnar(engine, now, scopes, scope_idx, blob, offsets)
+        stats.votes_replayed += len(offsets) - 1
+    elif kind == F.KIND_WIRE_COLUMNAR:
+        # Same payload as KIND_COLUMNAR, replayed through the WIRE path:
+        # the live call retained its chains wire-validated, so replay
+        # must too — routing through plain columnar ingest would demote
+        # ``wire_only`` and the recovered peer would silently drop the
+        # cross-frame dangling-vote guard its non-crashed twins keep
+        # (see format.KIND_WIRE_COLUMNAR). Only accepted rows were
+        # logged, so crypto is skipped: a trusted prepass marks every
+        # row verified — the KIND_COLUMNAR replay trust model, same WAL.
+        from ..bridge import columnar as C
+        from ..engine.engine import WireVotePrepass
+
+        now, scopes, scope_idx, blob, offsets = F.decode_columnar(payload)
+        offs = np.asarray(offsets, np.int64)
+        n = len(offs) - 1
+        cols, flags = C.parse_vote_columns(blob, offs)
+        if bool(flags.all()) and hasattr(engine, "ingest_wire_columnar"):
+            trusted = WireVotePrepass(
+                np.zeros(n, np.int32),
+                np.zeros(0, np.int64),
+                lambda: [],
+                buf=bytes(blob),
             )
-        else:
-            engine.ingest_columnar(
-                scopes[0], pids, gids, values, now, wire_votes=(blob, offsets)
+            engine.ingest_wire_columnar(
+                scopes,
+                scope_idx if scope_idx is not None else np.zeros(n, np.int64),
+                cols,
+                np.frombuffer(blob, np.uint8),
+                offs,
+                now,
+                _prepass=trusted,
             )
-        stats.votes_replayed += len(votes)
+        else:  # pragma: no cover — live rows were canonical by construction
+            _replay_columnar(engine, now, scopes, scope_idx, blob, offsets)
+        stats.votes_replayed += n
     elif kind == F.KIND_SCOPE_CONFIG:
         mode, scope, config = F.decode_scope_config_record(payload)
         if mode == F.SCOPE_CONFIG_INITIALIZE:

@@ -2882,6 +2882,135 @@ int64_t hg_ed25519_verify_batch_submit(const uint8_t* pubs,
   });
 }
 
-int hg_version() { return 3; }
+// ── Columnar wire-vote parsing (zero-copy bridge ingest) ──────────────
+//
+// Strict-canonical protobuf Vote parse: exactly the byte form the
+// package's own encoder (and the reference's prost codec) produces —
+// fields 20..28 in ascending order, each at most once, minimal varints,
+// zero/empty fields omitted, bool encoded as 1, no unknown fields, no
+// trailing bytes. Rows that match yield flag 1 and a column row; any
+// deviation (malformed OR merely non-canonical) yields flag 0 and the
+// caller falls back to the Python object decoder for the whole frame,
+// which is what makes fast-path and fallback statuses identical by
+// construction. The parse never touches the GIL.
+//
+// Column layout (int64[count][16]):
+//   0 vote_id   1 proposal_id   2 timestamp (u64 bits)   3 value
+//   4 owner_off  5 owner_len   6 parent_off  7 parent_len
+//   8 recv_off   9 recv_len   10 hash_off   11 hash_len
+//  12 sig_off   13 sig_len    14 sign_len (signing-payload prefix bytes)
+//  15 reserved
+// Offsets are absolute into `data`; absent fields report off=row start,
+// len=0; sign_len is the whole row when the signature field is absent.
+
+static constexpr int HG_VOTE_COLS = 16;
+
+// Minimal-encoding varint: returns consumed bytes (0 = malformed or
+// non-minimal or u64 overflow — all "not canonical" to the caller).
+static int read_varint_canonical(const uint8_t* p, int64_t len, int64_t pos,
+                                 uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0, i = 0;
+  while (true) {
+    if (pos + i >= len || i >= 10) return 0;
+    uint8_t b = p[pos + i];
+    if (shift == 63 && (b & 0x7E)) return 0;  // overflows u64
+    v |= (uint64_t)(b & 0x7F) << shift;
+    i++;
+    if (!(b & 0x80)) {
+      if (i > 1 && b == 0) return 0;  // non-minimal (trailing zero byte)
+      *out = v;
+      return i;
+    }
+    shift += 7;
+  }
+}
+
+static int parse_vote_canonical(const uint8_t* p, int64_t len, int64_t base,
+                                int64_t* col) {
+  for (int k = 0; k < HG_VOTE_COLS; k++) col[k] = 0;
+  col[4] = col[6] = col[8] = col[10] = col[12] = base;
+  col[14] = len;
+  int64_t pos = 0;
+  int last_field = 0;
+  while (pos < len) {
+    int64_t tag_start = pos;
+    uint64_t key;
+    int n = read_varint_canonical(p, len, pos, &key);
+    if (n <= 0) return 0;
+    pos += n;
+    int field = (int)(key >> 3), wt = (int)(key & 7);
+    if (field <= last_field || field < 20 || field > 28) return 0;
+    last_field = field;
+    if (field == 20 || field == 22 || field == 23 || field == 24) {
+      if (wt != 0) return 0;
+      uint64_t v;
+      int m = read_varint_canonical(p, len, pos, &v);
+      if (m <= 0) return 0;
+      pos += m;
+      if (v == 0) return 0;  // canonical encoders omit zero fields
+      if ((field == 20 || field == 22) && v > 0xFFFFFFFFull) return 0;
+      if (field == 24 && v != 1) return 0;
+      if (field == 20) col[0] = (int64_t)v;
+      else if (field == 22) col[1] = (int64_t)v;
+      else if (field == 23) col[2] = (int64_t)v;
+      else col[3] = 1;
+    } else {
+      if (wt != 2) return 0;
+      uint64_t l;
+      int m = read_varint_canonical(p, len, pos, &l);
+      if (m <= 0) return 0;
+      pos += m;
+      if (l == 0 || l > (uint64_t)(len - pos)) return 0;
+      int idx = field == 21 ? 4 : field == 25 ? 6 : field == 26 ? 8
+                : field == 27 ? 10 : 12;
+      col[idx] = base + pos;
+      col[idx + 1] = (int64_t)l;
+      if (field == 28) col[14] = tag_start;
+      pos += (int64_t)l;
+    }
+  }
+  return pos == len ? 1 : 0;
+}
+
+void hg_parse_vote_columns(const uint8_t* data, const uint64_t* offsets,
+                           int64_t count, int64_t* cols, uint8_t* flags,
+                           int n_threads) {
+  run_parallel(count, n_threads, 256, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++) {
+      int64_t base = (int64_t)offsets[i];
+      flags[i] = (uint8_t)parse_vote_canonical(
+          data + base, (int64_t)offsets[i + 1] - base, base,
+          cols + HG_VOTE_COLS * i);
+    }
+  });
+}
+
+// Batched compute_vote_hash over parsed columns: SHA-256 of
+// u32le(vote_id) | owner | u32le(pid) | u64le(ts) | value | parent |
+// received — the engine's protocol.compute_vote_hash byte order.
+void hg_vote_hash_columns(const uint8_t* data, const int64_t* cols,
+                          int64_t count, uint8_t* out, int n_threads) {
+  run_parallel(count, n_threads, 64, [&](int64_t lo, int64_t hi) {
+    std::vector<uint8_t> buf;
+    for (int64_t i = lo; i < hi; i++) {
+      const int64_t* c = cols + HG_VOTE_COLS * i;
+      buf.clear();
+      for (int k = 0; k < 4; k++)
+        buf.push_back((uint8_t)((uint64_t)c[0] >> (8 * k)));
+      buf.insert(buf.end(), data + c[4], data + c[4] + c[5]);
+      for (int k = 0; k < 4; k++)
+        buf.push_back((uint8_t)((uint64_t)c[1] >> (8 * k)));
+      for (int k = 0; k < 8; k++)
+        buf.push_back((uint8_t)((uint64_t)c[2] >> (8 * k)));
+      buf.push_back(c[3] ? 1 : 0);
+      buf.insert(buf.end(), data + c[6], data + c[6] + c[7]);
+      buf.insert(buf.end(), data + c[8], data + c[8] + c[9]);
+      sha256(buf.data(), buf.size(), out + 32 * i);
+    }
+  });
+}
+
+int hg_version() { return 4; }
 
 }  // extern "C"

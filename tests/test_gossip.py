@@ -396,7 +396,12 @@ class TestVoteCoalescer:
         assert ready is not None
         payload, meta = ready
         assert meta == [(1, "s", 2), (1, "t", 1)]
-        now, groups = P.decode_vote_batch(P.Cursor(payload))
+        # The payload is a SEGMENT LIST (send-side zero-copy): the tail
+        # segments ARE the caller's vote bytes objects, un-copied, and
+        # the joined stream is the canonical encode_vote_batch form.
+        assert isinstance(payload, list)
+        assert payload[1:] == [b"v1", b"v3", b"v2"]
+        now, groups = P.decode_vote_batch(P.Cursor(b"".join(payload)))
         assert now == NOW + 5  # the frame carries the window's max now
         assert groups == [(1, "s", [b"v1", b"v3"]), (1, "t", [b"v2"])]
         assert coalescer.pending("p") == 0
@@ -514,6 +519,33 @@ class TestBackpressure:
         finally:
             transport.close()
             stalled.close()
+
+    def test_frame_bigger_than_byte_cap_sends_when_queue_empty(self, server):
+        """The byte cap bounds QUEUED frames; a single frame larger than
+        the cap itself is admitted whenever the queue is empty —
+        otherwise it could never be sent at all (shed-retry forever)."""
+        transport = GossipTransport(max_queue_bytes=1024)
+        try:
+            transport.connect("p", *server.address)
+            future = transport.try_request("p", P.OP_PING, b"z" * 8192)
+            assert future is not None, "oversize frame was shed"
+            assert future.result(10).u32() == P.PROTOCOL_VERSION
+        finally:
+            transport.close()
+
+    def test_segment_count_past_iov_max_still_sends(self, server):
+        """sendmsg takes at most IOV_MAX iovecs per call; a frame built
+        from more segments than that must be written in capped passes,
+        not fail the channel with EINVAL."""
+        transport = GossipTransport()
+        try:
+            transport.connect("p", *server.address)
+            segments = [b"ab"] * 3000  # > IOV_MAX (1024 on Linux)
+            future = transport.try_request("p", P.OP_PING, segments)
+            assert future is not None
+            assert future.result(10).u32() == P.PROTOCOL_VERSION
+        finally:
+            transport.close()
 
 
 # ── GossipNode: fan-out, repair, escalation ────────────────────────────

@@ -112,3 +112,160 @@ def test_extract_trace_never_raises(junk):
     assert extract_trace(junk) is None or isinstance(
         extract_trace(junk), TraceContext
     )
+
+
+# ── Columnar OP_VOTE_BATCH decode: fuzz vs the object-path oracle ──────
+#
+# Two embedded bridge servers receive byte-identical frame sequences —
+# one with the zero-copy columnar wire path, one forced onto the
+# per-vote object decoder — and every response must match byte for byte:
+# malformed length columns, overflowing counts, truncated vote-bytes
+# regions, junk rows, valid signed chains, all of it.
+
+from hashgraph_tpu import build_vote  # noqa: E402
+from hashgraph_tpu.bridge import protocol as P  # noqa: E402
+from hashgraph_tpu.bridge.server import BridgeServer  # noqa: E402
+from hashgraph_tpu.signing.stub import StubConsensusSigner  # noqa: E402
+
+_NOW = 1_700_000_000
+
+
+class _Oracle:
+    def __init__(self):
+        self.pair = []
+        for wire_columnar in (True, False):
+            server = BridgeServer(
+                signer_factory=StubConsensusSigner,
+                capacity=512,
+                voter_capacity=16,
+                wire_columnar=wire_columnar,
+            )
+            server.start_embedded()
+            self.pair.append(server)
+        add = P.u8(32) + b"\x11" * 32
+        self.peer_id = P.Cursor(
+            self.dispatch(P.OP_ADD_PEER, add)[1]
+        ).u32()
+        self.scope_seq = 0
+
+    def dispatch(self, opcode, payload):
+        a = self.pair[0].dispatch_frame(opcode, payload)
+        b = self.pair[1].dispatch_frame(opcode, payload)
+        assert a == b, (
+            f"columnar/object divergence on opcode {opcode}: {a} != {b}"
+        )
+        return a
+
+    def fresh_session(self):
+        """A fresh scope + delivered proposal + its signed chain rows."""
+        self.scope_seq += 1
+        scope = f"fz-{self.scope_seq}"
+        proposal = Proposal(
+            name=scope,
+            payload=b"x",
+            proposal_id=self.scope_seq,
+            proposal_owner=b"\x11" * 20,
+            expected_voters_count=12,
+            timestamp=_NOW,
+            expiration_timestamp=_NOW + 3_600,
+            liveness_criteria_yes=True,
+        )
+        self.dispatch(
+            P.OP_PROCESS_PROPOSAL,
+            P.u32(self.peer_id) + P.string(scope) + P.u64(_NOW)
+            + P.blob(proposal.encode()),
+        )
+        rows = []
+        for i in range(1, 7):
+            vote = build_vote(
+                proposal, True, StubConsensusSigner(bytes([i]) * 20), _NOW + 1
+            )
+            proposal.votes.append(vote)
+            rows.append(vote.encode())
+        return scope, rows
+
+
+_oracle_holder: "list[_Oracle]" = []
+
+
+def _oracle() -> _Oracle:
+    if not _oracle_holder:
+        _oracle_holder.append(_Oracle())
+    return _oracle_holder[0]
+
+
+row_mutations = st.sampled_from(
+    ["keep", "flip", "truncate", "junk", "empty"]
+)
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    plan=st.lists(row_mutations, min_size=1, max_size=6),
+    junk_seed=st.integers(min_value=0, max_value=2**31),
+    data=st.data(),
+)
+def test_vote_batch_columnar_matches_object_path(plan, junk_seed, data):
+    """Row-level fuzz: every mutated/valid/junk row mix produces
+    byte-identical per-row statuses on both server paths, frame after
+    frame on one session (cross-frame guard state included)."""
+    oracle = _oracle()
+    scope, rows = oracle.fresh_session()
+    import random as _random
+
+    rng = _random.Random(junk_seed)
+    frame_rows = []
+    for kind, row in zip(plan, rows):
+        if kind == "keep":
+            frame_rows.append(row)
+        elif kind == "flip":
+            buf = bytearray(row)
+            buf[rng.randrange(len(buf))] ^= 1 + rng.randrange(255)
+            frame_rows.append(bytes(buf))
+        elif kind == "truncate":
+            frame_rows.append(row[:rng.randrange(len(row))])
+        elif kind == "junk":
+            frame_rows.append(rng.randbytes(rng.randrange(60)))
+        else:
+            frame_rows.append(b"")
+    group = [(oracle.peer_id, scope, frame_rows)]
+    status, _ = oracle.dispatch(
+        P.OP_VOTE_BATCH, P.encode_vote_batch(_NOW + 1, group)
+    )
+    assert status == P.STATUS_OK
+    # Second frame: the untouched remainder of the chain — exercises the
+    # cross-frame dangling guard identically on both paths.
+    rest = rows[len(plan):] or rows[:1]
+    oracle.dispatch(
+        P.OP_VOTE_BATCH,
+        P.encode_vote_batch(_NOW + 1, [(oracle.peer_id, scope, rest)]),
+    )
+
+
+@settings(max_examples=120, deadline=None)
+@given(
+    base_rows=st.integers(min_value=0, max_value=3),
+    cut=st.floats(min_value=0.0, max_value=1.0),
+    extra=st.binary(max_size=12),
+    bogus_count=st.integers(min_value=0, max_value=0xFFFFFFFF),
+)
+def test_vote_batch_frame_structure_fuzz(base_rows, cut, extra, bogus_count):
+    """Frame-level fuzz: truncations, trailing garbage, and overflowing
+    group counts report the SAME status (and message) on both paths —
+    the columnar views decoder shares the object decoder's wire
+    contract exactly."""
+    oracle = _oracle()
+    scope, rows = oracle.fresh_session()
+    payload = P.encode_vote_batch(
+        _NOW + 1, [(oracle.peer_id, scope, rows[:base_rows])]
+    )
+    truncated = payload[: int(len(payload) * cut)]
+    oracle.dispatch(P.OP_VOTE_BATCH, truncated)
+    oracle.dispatch(P.OP_VOTE_BATCH, payload + extra)
+    # Length column that overflows the frame (claimed count with no
+    # bytes behind it).
+    oracle.dispatch(
+        P.OP_VOTE_BATCH,
+        P.u64(_NOW) + P.u32(1) + P.u32(oracle.peer_id) + P.string(scope)
+        + P.u32(bogus_count),
+    )
