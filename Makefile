@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-catchup bench-gossip bench-chaos fleet-smoke catchup-smoke gossip-smoke chaos-smoke metrics-smoke trace-smoke smoke
+.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-catchup bench-gossip bench-chaos bench-device-verify fleet-smoke catchup-smoke gossip-smoke chaos-smoke metrics-smoke trace-smoke smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -91,6 +91,18 @@ bench-chaos:
 # `scenarios: {passed, failed, seeds}` block.
 chaos-smoke:
 	JAX_PLATFORMS=cpu python bench.py chaos --smoke
+
+# Device-vs-host-pool Ed25519 batch-verify A/B (the crypto_device
+# subsystem): same signed corpus through both verify_batch backends,
+# interleaved reps at 256/1k/4k/16k (SMOKE=1: 256/1k for CI), per-phase
+# device timings (decompress/SHA-512/MSM) and a machine-readable
+# noise_verdict that names the winner honestly — on CPU backends the
+# native pool wins; the device path is for accelerator hardware. The
+# persistent XLA compile cache (bench.py's default) keeps recompiles
+# from dominating repeat runs.
+SMOKE ?= 0
+bench-device-verify:
+	python bench.py device-verify $(if $(filter 1,$(SMOKE)),--smoke,)
 
 # End-to-end observability check: start a bridge server (WAL + HTTP
 # sidecar), drive a proposal to decision, scrape /metrics + /healthz and

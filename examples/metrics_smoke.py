@@ -56,6 +56,13 @@ REQUIRED_FAMILIES = [
     "hashgraph_verify_pool_queue_depth",
     "hashgraph_verified_signatures_total",
     'hashgraph_verified_signatures_total{scheme="',
+    # Device-resident batch verification (crypto_device): counters +
+    # histogram exist from process start — a dashboard must see the
+    # device families even on a host-verifying node (they read 0).
+    "hashgraph_device_verify_batches_total",
+    "hashgraph_device_verify_signatures_total",
+    "hashgraph_device_verify_fallbacks_total",
+    "hashgraph_device_verify_seconds_bucket",
     # State-sync families: snapshot chunks served/received, WAL tail
     # records applied, end-to-end catch-up seconds (histogram). Eagerly
     # installed so a dashboard sees them before the first catch-up; the

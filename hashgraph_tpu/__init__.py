@@ -61,6 +61,7 @@ from .session import ConsensusConfig, ConsensusSession, ConsensusState
 from .signing import (
     ConsensusSignatureScheme,
     Ed25519ConsensusSigner,
+    Ed25519DeviceConsensusSigner,
     EthereumConsensusSigner,
     StubConsensusSigner,
 )
@@ -117,6 +118,7 @@ __all__ = [
     "SessionTransition",
     "ConsensusSignatureScheme",
     "Ed25519ConsensusSigner",
+    "Ed25519DeviceConsensusSigner",
     "EthereumConsensusSigner",
     "StubConsensusSigner",
     "build_vote",

@@ -1,0 +1,135 @@
+"""Optional Pallas kernel for the MSM inner loop's field multiply.
+
+The MSM spends ~95% of its time in fe.mul; on TPU the jnp path's
+scatter-add into product columns round-trips HBM between the outer
+product and the carry chain. This kernel keeps one lane block's columns
+resident in VMEM: the 16-limb schoolbook runs as a fori_loop over the
+multiplicand limbs accumulating into a (32, block) scratch, then folds
+and carries in-register before the single write-back.
+
+Layout: limbs-major ``int32[16, L]`` (lanes on the 128-wide lane axis —
+the transpose of the jnp path's ``[..., 16]``) so the VPU sees full
+tiles. int32 stands in for uint32 (TPU Pallas int support): 16x16-bit
+products may wrap the sign bit, but wrapping is exact mod 2^32 and the
+hi/lo split masks through it (`(p >> 16) & 0xffff` after an arithmetic
+shift equals the logical result).
+
+Strictly optional: :func:`enabled` is False unless the backend is a
+real TPU (or HASHGRAPH_TPU_DEVICE_VERIFY_PALLAS=interpret forces the
+interpreter for tests), and any lowering failure latches the jnp path —
+CPU CI runs the identical field core either way (ROADMAP item 2's
+"pure-jax.numpy path everywhere else").
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+_MASK = (1 << 16) - 1
+_FOLD = 38
+_LIMBS = 16
+
+_state: "dict[str, bool | None]" = {"enabled": None, "interpret": False}
+
+
+def _probe() -> bool:
+    mode = os.environ.get("HASHGRAPH_TPU_DEVICE_VERIFY_PALLAS", "").lower()
+    if mode in ("0", "off"):
+        return False
+    if mode == "interpret":
+        _state["interpret"] = True
+        return True
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return False
+    if backend != "tpu" and mode not in ("1", "on"):
+        return False
+    try:  # lowering probe: latch off on any failure
+        a = jnp.zeros((_LIMBS, 8), jnp.int32)
+        _fe_mul_tl(a, a).block_until_ready()
+        return True
+    except Exception:
+        return False
+
+
+def enabled() -> bool:
+    if _state["enabled"] is None:
+        _state["enabled"] = _probe()
+    return bool(_state["enabled"])
+
+
+def _mul_kernel(a_ref, b_ref, out_ref):
+    a = a_ref[:]  # [16, L] int32, limbs < 2^16
+    b = b_ref[:]
+    lanes = a.shape[1]
+    cols = jnp.zeros((2 * _LIMBS, lanes), jnp.int32)
+
+    def limb_step(i, cols):
+        ai = jax.lax.dynamic_slice_in_dim(a, i, 1, axis=0)  # [1, L]
+        prod = ai * b  # wraps int32; exact mod 2^32
+        lo = prod & _MASK
+        hi = jax.lax.shift_right_logical(prod, 16) & _MASK
+        lo_pad = jax.lax.pad(
+            lo, jnp.int32(0),
+            [(0, 2 * _LIMBS - _LIMBS, 0), (0, 0, 0)],
+        )
+        hi_pad = jax.lax.pad(
+            hi, jnp.int32(0),
+            [(0, 2 * _LIMBS - _LIMBS, 0), (0, 0, 0)],
+        )
+        shifted_lo = _roll_down(lo_pad, i)
+        shifted_hi = _roll_down(hi_pad, i + 1)
+        return cols + shifted_lo + shifted_hi
+
+    cols = jax.lax.fori_loop(0, _LIMBS, limb_step, cols)
+    t = cols[:_LIMBS] + cols[_LIMBS:] * _FOLD
+    for _ in range(3):  # the shared three-pass carry (see field.carry)
+        out = []
+        carry = jnp.zeros((t.shape[1],), jnp.int32)
+        for i in range(_LIMBS):
+            cur = t[i] + carry
+            out.append(cur & _MASK)
+            carry = jax.lax.shift_right_logical(cur, 16)
+        t = jnp.stack(out)
+        t = t.at[0].add(carry * _FOLD)
+    out_ref[:] = t
+
+
+def _roll_down(x, k):
+    """Shift rows down by (traced) k, zero-filling the top."""
+    n = x.shape[0]
+    idx = jnp.arange(n) - k
+    gathered = x[jnp.clip(idx, 0, n - 1)]
+    return jnp.where((idx >= 0)[:, None], gathered, 0)
+
+
+@jax.jit
+def _fe_mul_tl(a_tl, b_tl):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        _mul_kernel,
+        out_shape=jax.ShapeDtypeStruct(a_tl.shape, jnp.int32),
+        interpret=_state["interpret"],
+    )(a_tl, b_tl)
+
+
+def fe_mul(a, b):
+    """Drop-in for field._mul_jnp: accepts/returns the jnp layout
+    (uint32[..., 16]) and runs the transposed Pallas kernel."""
+    shape = a.shape
+    a_tl = jnp.moveaxis(a.reshape(-1, _LIMBS), -1, 0).astype(jnp.int32)
+    b_tl = jnp.moveaxis(b.reshape(-1, _LIMBS), -1, 0).astype(jnp.int32)
+    out = _fe_mul_tl(a_tl, b_tl)
+    return jnp.moveaxis(out, 0, -1).astype(jnp.uint32).reshape(shape)
+
+
+def reset_for_tests() -> None:
+    """Re-run the probe (tests flip the env override)."""
+    _state["enabled"] = None
+    _state["interpret"] = False
+    _fe_mul_tl.clear_cache()

@@ -1,0 +1,227 @@
+"""Vectorized SHA-512 over ragged byte rows, in uint32 pairs.
+
+The per-signature Ed25519 challenge hash k = SHA-512(R || A || M) is the
+second-largest cost of batch verification after the MSM; this runs every
+lane's compression in lockstep on device. With ``jax_enable_x64`` off
+there is no 64-bit lane, so every 64-bit word is an (hi, lo) uint32 pair
+and the adders carry explicitly (carry = lo_sum < lo_a, exact for
+wrapping uint32) — the same decomposition GPU SHA implementations use on
+32-bit ALUs.
+
+Ragged batches pad to a shared block count (bucketed by the caller to
+bound compiled shapes); a lane whose message ends early freezes its
+state via a per-block mask, so one ``lax.fori_loop`` serves every length
+in the batch. Block packing happens host-side in numpy — it is O(bytes)
+data movement, not crypto.
+
+Constants are derived, not transcribed: K[t] / H0 are the fractional
+parts of cube/square roots of the first primes (FIPS 180-4), computed
+with integer Newton roots at import and pinned against hashlib by the
+test battery.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_U32 = jnp.uint32
+
+BLOCK = 128  # bytes per SHA-512 block
+
+
+def _primes(n: int) -> "list[int]":
+    out, c = [], 2
+    while len(out) < n:
+        if all(c % p for p in out):
+            out.append(c)
+        c += 1
+    return out
+
+
+def _iroot(x: int, k: int) -> int:
+    """Integer floor k-th root (Newton on Python ints)."""
+    if x == 0:
+        return 0
+    r = 1 << ((x.bit_length() + k - 1) // k)
+    while True:
+        nr = ((k - 1) * r + x // r ** (k - 1)) // k
+        if nr >= r:
+            return r
+        r = nr
+
+
+def _frac_root_bits(p: int, k: int) -> int:
+    """First 64 fractional bits of p^(1/k)."""
+    return _iroot(p << (64 * k), k) & ((1 << 64) - 1)
+
+
+_K64 = [_frac_root_bits(p, 3) for p in _primes(80)]
+_H64 = [_frac_root_bits(p, 2) for p in _primes(8)]
+
+K_HI = np.array([k >> 32 for k in _K64], np.uint32)
+K_LO = np.array([k & 0xFFFFFFFF for k in _K64], np.uint32)
+H0_HI = np.array([h >> 32 for h in _H64], np.uint32)
+H0_LO = np.array([h & 0xFFFFFFFF for h in _H64], np.uint32)
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    hi = ah + bh + (lo < al).astype(_U32)
+    return hi, lo
+
+
+def _ror64(h, lo, r: int):
+    if r == 0:
+        return h, lo
+    if r < 32:
+        return (
+            (h >> r) | (lo << (32 - r)),
+            (lo >> r) | (h << (32 - r)),
+        )
+    if r == 32:
+        return lo, h
+    r -= 32
+    return (
+        (lo >> r) | (h << (32 - r)),
+        (h >> r) | (lo << (32 - r)),
+    )
+
+
+def _shr64(h, lo, r: int):
+    if r < 32:
+        return h >> r, (lo >> r) | (h << (32 - r))
+    return jnp.zeros_like(h), h >> (r - 32)
+
+
+def _sigma(h, lo, r1, r2, r3, shift: bool):
+    ah, al = _ror64(h, lo, r1)
+    bh, bl = _ror64(h, lo, r2)
+    ch, cl = _shr64(h, lo, r3) if shift else _ror64(h, lo, r3)
+    return ah ^ bh ^ ch, al ^ bl ^ cl
+
+
+@jax.jit
+def _sha512_blocks(words, nblocks):
+    """words: uint32[L, B, 32] (big-endian 64-bit message words as
+    (hi, lo) uint32 pairs), nblocks: int32[L] true block counts.
+    Returns uint32[L, 16] digest words (hi, lo interleaved)."""
+    lanes, max_blocks, _ = words.shape
+    k_hi, k_lo = jnp.asarray(K_HI), jnp.asarray(K_LO)
+    state_hi = jnp.broadcast_to(jnp.asarray(H0_HI), (lanes, 8)).astype(_U32)
+    state_lo = jnp.broadcast_to(jnp.asarray(H0_LO), (lanes, 8)).astype(_U32)
+
+    def block_step(b, state):
+        s_hi, s_lo = state
+        # Rolling 16-word schedule window, stacked [16, L]; extension
+        # for round t+16 is computed every round (discarded past 64) so
+        # the 80 rounds stay ONE rolled fori_loop.
+        win_hi = jnp.stack([words[:, b, 2 * t] for t in range(16)])
+        win_lo = jnp.stack([words[:, b, 2 * t + 1] for t in range(16)])
+
+        def rnd(t, carry):
+            (win_hi, win_lo, ah, al, bh, bl, ch, cl, dh, dl,
+             eh, el, fh, fl, gh, gl, hh, hl) = carry
+            wh, wl = win_hi[0], win_lo[0]
+            s1h, s1l = _sigma(eh, el, 14, 18, 41, False)
+            chh = (eh & fh) ^ (~eh & gh)
+            chl = (el & fl) ^ (~el & gl)
+            t1h, t1l = _add64(hh, hl, s1h, s1l)
+            t1h, t1l = _add64(t1h, t1l, chh, chl)
+            t1h, t1l = _add64(t1h, t1l, k_hi[t], k_lo[t])
+            t1h, t1l = _add64(t1h, t1l, wh, wl)
+            s0h, s0l = _sigma(ah, al, 28, 34, 39, False)
+            majh = (ah & bh) ^ (ah & ch) ^ (bh & ch)
+            majl = (al & bl) ^ (al & cl) ^ (bl & cl)
+            t2h, t2l = _add64(s0h, s0l, majh, majl)
+            ne_h, ne_l = _add64(dh, dl, t1h, t1l)
+            na_h, na_l = _add64(t1h, t1l, t2h, t2l)
+            sg0h, sg0l = _sigma(win_hi[1], win_lo[1], 1, 8, 7, True)
+            sg1h, sg1l = _sigma(win_hi[14], win_lo[14], 19, 61, 6, True)
+            nh, nl = _add64(win_hi[0], win_lo[0], sg0h, sg0l)
+            nh, nl = _add64(nh, nl, win_hi[9], win_lo[9])
+            nh, nl = _add64(nh, nl, sg1h, sg1l)
+            win_hi = jnp.concatenate([win_hi[1:], nh[None]])
+            win_lo = jnp.concatenate([win_lo[1:], nl[None]])
+            return (win_hi, win_lo, na_h, na_l, ah, al, bh, bl, ch, cl,
+                    ne_h, ne_l, eh, el, fh, fl, gh, gl)
+
+        init = (win_hi, win_lo,
+                s_hi[:, 0], s_lo[:, 0], s_hi[:, 1], s_lo[:, 1],
+                s_hi[:, 2], s_lo[:, 2], s_hi[:, 3], s_lo[:, 3],
+                s_hi[:, 4], s_lo[:, 4], s_hi[:, 5], s_lo[:, 5],
+                s_hi[:, 6], s_lo[:, 6], s_hi[:, 7], s_lo[:, 7])
+        regs = lax.fori_loop(0, 80, rnd, init)[2:]
+        new_hi, new_lo = [], []
+        for i in range(8):
+            nh, nl = _add64(s_hi[:, i], s_lo[:, i],
+                            regs[2 * i], regs[2 * i + 1])
+            new_hi.append(nh)
+            new_lo.append(nl)
+        new_hi = jnp.stack(new_hi, axis=1)
+        new_lo = jnp.stack(new_lo, axis=1)
+        # Lanes whose message ended before block b keep their state.
+        live = (b < nblocks)[:, None]
+        return (jnp.where(live, new_hi, s_hi),
+                jnp.where(live, new_lo, s_lo))
+
+    state_hi, state_lo = lax.fori_loop(
+        0, max_blocks, block_step, (state_hi, state_lo)
+    )
+    return jnp.stack([state_hi, state_lo], axis=-1).reshape(lanes, 16)
+
+
+def blocks_needed(length: int) -> int:
+    """SHA-512 block count for a message of ``length`` bytes (payload +
+    0x80 + 128-bit length field)."""
+    return (length + 17 + BLOCK - 1) // BLOCK
+
+
+def sha512_batch_dispatch(messages: "list[bytes]", max_blocks: int):
+    """Pack + dispatch the batch; returns the un-materialized device
+    array of digest words (callers overlap other work, then hand it to
+    :func:`digest_bytes`). ``max_blocks`` is the caller's bucket (>=
+    every message's block count; bucketing bounds compiled shapes)."""
+    lanes = len(messages)
+    nblocks = np.array([blocks_needed(len(m)) for m in messages], np.int32)
+    if int(nblocks.max()) > max_blocks:
+        raise ValueError("max_blocks bucket too small for batch")
+    buf = np.zeros((lanes, max_blocks * BLOCK), np.uint8)
+    for i, msg in enumerate(messages):
+        n = len(msg)
+        end = int(nblocks[i]) * BLOCK  # pad at the lane's OWN final block
+        buf[i, :n] = np.frombuffer(msg, np.uint8)
+        buf[i, n] = 0x80
+        buf[i, end - 16:end] = np.frombuffer(
+            (n * 8).to_bytes(16, "big"), np.uint8
+        )
+    words = buf.reshape(lanes, max_blocks, BLOCK // 4, 4)
+    w32 = (
+        (words[..., 0].astype(np.uint32) << 24)
+        | (words[..., 1].astype(np.uint32) << 16)
+        | (words[..., 2].astype(np.uint32) << 8)
+        | words[..., 3].astype(np.uint32)
+    )
+    return _sha512_blocks(jnp.asarray(w32), jnp.asarray(nblocks))
+
+
+def digest_bytes(digest_words) -> np.ndarray:
+    """Materialize dispatched digest words into uint8[L, 64] digests."""
+    digest_words = np.asarray(digest_words)
+    lanes = digest_words.shape[0]
+    out = np.zeros((lanes, 64), np.uint8)
+    for w in range(16):  # big-endian bytes of each 32-bit half-word
+        word = digest_words[:, w]
+        for byte in range(4):
+            out[:, 4 * w + 3 - byte] = (word >> (8 * byte)) & 0xFF
+    return out
+
+
+def sha512_batch(messages: "list[bytes]", max_blocks: int) -> np.ndarray:
+    """SHA-512 digests (uint8[L, 64]) for every message in one device
+    dispatch: dispatch + materialize."""
+    if not messages:
+        return np.zeros((0, 64), np.uint8)
+    return digest_bytes(sha512_batch_dispatch(messages, max_blocks))

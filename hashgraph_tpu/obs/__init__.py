@@ -45,6 +45,9 @@ hashgraph_timeouts_fired_total                  counter    engine timeout paths
 hashgraph_verify_cache_{hits,misses,negative_hits,evictions}_total  counter  VerifiedVoteCache (memoized admission)
 hashgraph_verified_signatures_total (+ {scheme=...})  counter    engine verify prepass (cache hits excluded)
 hashgraph_verify_pool_queue_depth               gauge      native verify-pool backlog (scrape-time)
+hashgraph_device_verify_{batches,signatures}_total  counter  crypto_device backend (batches / sigs dispatched)
+hashgraph_device_verify_fallbacks_total         counter    crypto_device backend (host blame escalations)
+hashgraph_device_verify_seconds                 histogram  crypto_device backend (end-to-end batch verify)
 bridge_requests_total / bridge_errors_total     counter    bridge dispatch loop
 flight_dumps_total                              counter    flight recorder dump sites
 wal_checkpoints_total                           counter    DurableEngine checkpoints
@@ -154,6 +157,14 @@ VERIFY_CACHE_EVICTIONS_TOTAL = "hashgraph_verify_cache_evictions_total"
 VERIFIED_SIGNATURES_TOTAL = "hashgraph_verified_signatures_total"
 # Native verify-pool tasks queued + running, sampled at scrape time.
 VERIFY_POOL_QUEUE_DEPTH = "hashgraph_verify_pool_queue_depth"
+# Device-resident Ed25519 batch verification (crypto_device.backend):
+# batches/signatures dispatched to the device pipeline, host-blame
+# escalations after a failed linear combination, and end-to-end batch
+# wall time (decompress + SHA-512 + MSM + any blame pass).
+DEVICE_VERIFY_BATCHES_TOTAL = "hashgraph_device_verify_batches_total"
+DEVICE_VERIFY_SIGNATURES_TOTAL = "hashgraph_device_verify_signatures_total"
+DEVICE_VERIFY_FALLBACKS_TOTAL = "hashgraph_device_verify_fallbacks_total"
+DEVICE_VERIFY_SECONDS = "hashgraph_device_verify_seconds"
 BUILD_INFO = "hashgraph_build_info"
 # Device/XLA telemetry (providers installed by install_jax_telemetry —
 # called from engine construction so obs itself stays jax-free).
@@ -222,6 +233,7 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         WAL_RECOVER_SECONDS,
         FLEET_SWEEP_SECONDS,
         SYNC_CATCHUP_SECONDS,
+        DEVICE_VERIFY_SECONDS,
     ):
         reg.histogram(name, DEFAULT_TIME_BUCKETS)
     reg.histogram(INGEST_BATCH_SIZE, DEFAULT_SIZE_BUCKETS)
@@ -257,6 +269,9 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         VERIFY_CACHE_NEGATIVE_HITS_TOTAL,
         VERIFY_CACHE_EVICTIONS_TOTAL,
         VERIFIED_SIGNATURES_TOTAL,
+        DEVICE_VERIFY_BATCHES_TOTAL,
+        DEVICE_VERIFY_SIGNATURES_TOTAL,
+        DEVICE_VERIFY_FALLBACKS_TOTAL,
         ALERTS_TOTAL,
         EQUIVOCATIONS_TOTAL,
         FORK_REDELIVERIES_TOTAL,

@@ -20,6 +20,7 @@ __all__ = [
     "ConsensusSignatureScheme",
     "ConsensusSchemeError",
     "Ed25519ConsensusSigner",
+    "Ed25519DeviceConsensusSigner",
     "EthereumConsensusSigner",
     "PendingVerdicts",
     "StubConsensusSigner",
@@ -109,6 +110,9 @@ class ConsensusSignatureScheme(abc.ABC):
         )
 
 
-from .ed25519 import Ed25519ConsensusSigner  # noqa: E402
+from .ed25519 import (  # noqa: E402
+    Ed25519ConsensusSigner,
+    Ed25519DeviceConsensusSigner,
+)
 from .ethereum import EthereumConsensusSigner  # noqa: E402
 from .stub import StubConsensusSigner  # noqa: E402

@@ -25,6 +25,7 @@ input. See PARITY.md.
 
 from __future__ import annotations
 
+import os
 import secrets
 
 from ..errors import ConsensusSchemeError
@@ -35,11 +36,53 @@ from . import _ed25519 as _py
 ED25519_SIGNATURE_LENGTH = 64
 ED25519_IDENTITY_LENGTH = 32
 
+# Backend selector for batch verification: instances resolve
+# device_verify=None against this env at construction. "1"/"on"/"true"
+# routes verify_batch/_submit through hashgraph_tpu.crypto_device (the
+# JAX pipeline — TPU/GPU/CPU alike); anything else keeps the native
+# pool / pure-Python host path. The env seam means a bridge server, the
+# sim cluster, and the engine's ingest_wire_columnar crypto prepass all
+# reach the device path with zero caller changes.
+DEVICE_VERIFY_ENV = "HASHGRAPH_TPU_DEVICE_VERIFY"
+
+
+def _device_verify_default() -> bool:
+    return os.environ.get(DEVICE_VERIFY_ENV, "").lower() in ("1", "on", "true")
+
 
 class Ed25519ConsensusSigner(ConsensusSignatureScheme):
-    """Holds a 32-byte seed; identity is the derived public key."""
+    """Holds a 32-byte seed; identity is the derived public key.
 
-    def __init__(self, seed: bytes):
+    ``device_verify`` selects the batch-verification backend:
+
+    - ``None`` (default): consult ``HASHGRAPH_TPU_DEVICE_VERIFY``;
+    - ``True``: the instance is constructed as
+      :class:`Ed25519DeviceConsensusSigner`, whose class-level batch
+      verifiers run the JAX device pipeline (engines resolve scheme
+      methods through ``type(signer)``, so the choice rides the
+      instance into every ``verify_batch_submit`` call site, and the
+      per-scheme metric label / admission-cache namespace pick up the
+      distinct subclass identity);
+    - ``False``: force the host path even when the env is set.
+
+    Signing and scalar ``verify`` are host-side in every case; the
+    backends differ only in who executes the batch equation, never in
+    verdicts (PARITY.md "Device-resident verification").
+    """
+
+    def __new__(cls, seed: bytes = b"", device_verify: "bool | None" = None):
+        if cls is Ed25519ConsensusSigner:
+            enabled = (
+                _device_verify_default()
+                if device_verify is None
+                else bool(device_verify)
+            )
+            if enabled and _device_backend_usable():
+                cls = Ed25519DeviceConsensusSigner
+        return super().__new__(cls)
+
+    def __init__(self, seed: bytes, device_verify: "bool | None" = None):
+        del device_verify  # consumed by __new__ (class identity carries it)
         if len(seed) != 32:
             raise ValueError("ed25519 seed must be 32 bytes")
         self._seed = bytes(seed)
@@ -170,6 +213,84 @@ class Ed25519ConsensusSigner(ConsensusSignatureScheme):
             if job is not None:
                 for i, code in zip(well_formed, job.collect()):
                     out[i] = bool(code == 1)
+            return out
+
+        return PendingVerdicts(_collect)
+
+
+def _device_backend_usable() -> bool:
+    """Probe (memoized in crypto_device) that the JAX pipeline can run;
+    selection quietly degrades to the host path when it cannot, so
+    setting the env on a jax-less box never breaks verification."""
+    try:
+        from .. import crypto_device
+
+        return crypto_device.available()
+    except Exception:
+        return False
+
+
+class Ed25519DeviceConsensusSigner(Ed25519ConsensusSigner):
+    """Ed25519 with device-resident batch verification.
+
+    Same wire format, same seed handling, same scalar ``verify``, same
+    *cofactored* acceptance criterion — a backend, not a divergence:
+    ``verify_batch``/``verify_batch_submit`` run the whole batch
+    equation (decompression, SHA-512 challenge hashes, the randomized
+    Straus MSM) on the JAX backend via :mod:`hashgraph_tpu.crypto_device`,
+    with host blame for exact per-item verdicts when the combination
+    fails. Constructed via ``Ed25519ConsensusSigner(seed,
+    device_verify=True)`` or the ``HASHGRAPH_TPU_DEVICE_VERIFY`` env;
+    the distinct class name labels the per-scheme verified-signatures
+    counter and namespaces the admission cache."""
+
+    @classmethod
+    def device_phase_seconds(cls) -> "dict[str, float]":
+        """Per-phase wall seconds of the backend's most recent batch
+        (decompress / hash / msm / fallback / total) — the engine's
+        wire-path stage attribution and the bench's timing block both
+        read this instead of re-instrumenting the pipeline."""
+        from .. import crypto_device
+
+        return crypto_device.last_phase_seconds()
+
+    @classmethod
+    def verify_batch(
+        cls,
+        identities: "list[bytes]",
+        payloads: "list[bytes]",
+        signatures: "list[bytes]",
+    ) -> list:
+        return cls.verify_batch_submit(
+            identities, payloads, signatures
+        ).collect()
+
+    @classmethod
+    def verify_batch_submit(
+        cls,
+        identities: "list[bytes]",
+        payloads: "list[bytes]",
+        signatures: "list[bytes]",
+    ) -> PendingVerdicts:
+        """Dispatch decompression + challenge hashing to the device NOW;
+        ``collect()`` finishes the MSM and fans out verdicts (falling
+        back to the host verifiers for per-item blame on batch
+        failure). Scheme errors and ragged truncation are handled by
+        the shared precheck, byte-compatible with the host path."""
+        from .. import crypto_device
+
+        out, well_formed = cls._precheck(identities, payloads, signatures)
+        if not well_formed:
+            return PendingVerdicts(lambda: out)
+        collect_device = crypto_device.verify_batch_begin(
+            [bytes(identities[i]) for i in well_formed],
+            [payloads[i] for i in well_formed],
+            [bytes(signatures[i]) for i in well_formed],
+        )
+
+        def _collect():
+            for i, verdict in zip(well_formed, collect_device()):
+                out[i] = bool(verdict)
             return out
 
         return PendingVerdicts(_collect)

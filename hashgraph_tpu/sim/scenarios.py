@@ -388,18 +388,30 @@ SCENARIOS: "dict[str, _Spec]" = {
 
 
 def run_scenario(
-    name: str, seed: int, *, root: "str | None" = None, blind: bool = False
+    name: str,
+    seed: int,
+    *,
+    root: "str | None" = None,
+    blind: bool = False,
+    signer_factory: "type | None" = None,
 ) -> dict:
     """One scenario at one seed -> the verdict JSON (a dict; serialize
     with ``sort_keys=True`` for the byte-identical determinism check).
     ``blind=True`` disables the health/evidence layer first — the
-    harness's self-test that a broken injector run FAILS."""
+    harness's self-test that a broken injector run FAILS.
+    ``signer_factory`` overrides the cluster's scheme (default stub):
+    the device-crypto battery re-runs the signature scenarios with
+    ``Ed25519DeviceConsensusSigner`` to prove all three verdicts hold
+    when rejects come from the device backend."""
     spec = SCENARIOS[name]
+    kwargs = dict(spec.cluster_kwargs)
+    if signer_factory is not None:
+        kwargs["signer_factory"] = signer_factory
     owns_root = root is None
     if owns_root:
         root = tempfile.mkdtemp(prefix=f"hashgraph-chaos-{name}-")
     try:
-        with SimCluster(root, seed, **spec.cluster_kwargs) as cluster:
+        with SimCluster(root, seed, **kwargs) as cluster:
             if blind:
                 _blind(cluster)
             culprits, checks, detail = spec.body(cluster)

@@ -21,3 +21,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy cases tier-1 skips (-m 'not slow'); the device-crypto "
+        "CI job and `pytest -m slow` run them",
+    )
