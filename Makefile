@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-catchup bench-gossip bench-chaos bench-device-verify fleet-smoke catchup-smoke gossip-smoke chaos-smoke metrics-smoke trace-smoke smoke
+.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-federation bench-catchup bench-gossip bench-chaos bench-device-verify fleet-smoke federation-smoke catchup-smoke gossip-smoke chaos-smoke metrics-smoke trace-smoke smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -43,6 +43,24 @@ bench-fleet:
 # fleet routing, the psum tally path, and the sweep on every PR.
 fleet-smoke:
 	JAX_PLATFORMS=cpu python bench.py fleet --smoke
+
+# Federated multi-host bench: N OS processes (one FleetGroup each —
+# examples/federation_host.py), two-level (host, shard) placement,
+# cross-host vote routing over coalesced OP_VOTE_BATCH frames, fabric
+# OP_FLEET_TALLY tallies, paired federated-vs-single-host A/B with a
+# machine-readable noise_verdict, and a LIVE SHARD MIGRATION under
+# sustained traffic (freeze -> snapshot+tail adopt -> fingerprint
+# equality -> atomic flip -> tail replay) with zero-lost-votes and
+# zero-lost-decisions asserts. HOSTS=N picks the host count.
+HOSTS ?= 2
+bench-federation:
+	JAX_PLATFORMS=cpu python bench.py fleet --hosts $(HOSTS)
+
+# CI short run: 2 OS processes on CPU, tiny shapes, one migration —
+# the whole federation surface (remote routing, tallies, migration,
+# typed retry-after window) on every PR.
+federation-smoke:
+	JAX_PLATFORMS=cpu python bench.py fleet --hosts 2 --smoke
 
 # State-sync catch-up bench: snapshot+tail vs full WAL replay at several
 # history lengths, paired same-window A/B with a machine-readable

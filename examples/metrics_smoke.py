@@ -71,6 +71,15 @@ REQUIRED_FAMILIES = [
     "hashgraph_sync_chunks_received_total",
     "hashgraph_sync_tail_records_total",
     "hashgraph_sync_catchup_seconds_bucket",
+    # Federated fleet families: hosts gauge, votes routed to remotely
+    # owned scopes over the fabric, shard migrations + their wall time.
+    # Eagerly installed — a single-host node's dashboard must still see
+    # them (at 0) before the operator ever federates; the traffic is
+    # exercised by `bench.py fleet --hosts 2` and tests/test_federation.py.
+    "hashgraph_federation_hosts",
+    "hashgraph_federation_remote_routed_votes_total",
+    "hashgraph_federation_migrations_total",
+    "hashgraph_federation_migration_seconds_bucket",
 ]
 
 

@@ -411,6 +411,13 @@ class BridgeClient:
         peers are state-identical iff their fingerprints match."""
         return self._call(P.OP_STATE_FINGERPRINT, P.u32(peer)).string()
 
+    def fleet_tally(self, peer: int) -> "dict[int, int]":
+        """The peer engine's slot-state histogram (``OP_FLEET_TALLY``) as
+        {state_code: count}. Against a federation host this is the whole
+        local fleet's tally — the frame a driver sums across hosts when
+        the backend lacks cross-process collectives."""
+        return P.parse_fleet_tally(self._call(P.OP_FLEET_TALLY, P.u32(peer)))
+
     def hello(self, features: int | None = None) -> int:
         """Feature negotiation (``OP_HELLO``); returns the granted bits.
         The default offer deliberately EXCLUDES ``FEATURE_PIPELINING``:

@@ -188,6 +188,35 @@ def parse_vote_columns(
     return parse_vote_columns_py(data, offsets)
 
 
+def pack_rows(
+    data: np.ndarray, offsets: np.ndarray, cols: np.ndarray, rows: np.ndarray
+) -> "tuple[np.ndarray, np.ndarray, np.ndarray]":
+    """Gather ``rows`` (possibly non-contiguous) of a parsed frame into
+    one contiguous ``(data, offsets, cols)`` triple, the absolute offset
+    columns rebased — vectorized, no per-row Python slicing. One home
+    for the bridge server's per-peer packing and the federation
+    adapter's per-shard packing."""
+    starts = offsets[rows]
+    lens = offsets[rows + 1] - starts
+    sub_offsets = np.zeros(len(rows) + 1, np.int64)
+    np.cumsum(lens, out=sub_offsets[1:])
+    total = int(sub_offsets[-1])
+    gather = (
+        np.arange(total, dtype=np.int64)
+        - np.repeat(sub_offsets[:-1], lens)
+        + np.repeat(starts, lens)
+    )
+    sub_data = data[gather]
+    sub_cols = cols[rows].copy()
+    delta = sub_offsets[:-1] - starts
+    for col in (
+        COL_OWNER_OFF, COL_PARENT_OFF, COL_RECV_OFF, COL_HASH_OFF,
+        COL_SIG_OFF,
+    ):
+        sub_cols[:, col] += delta
+    return sub_data, sub_offsets, sub_cols
+
+
 def vote_hash_columns(data, cols: np.ndarray) -> np.ndarray:
     """Batched ``compute_vote_hash`` over parsed columns: uint8[N, 32].
     Native when present; the Python twin rebuilds each hash input from

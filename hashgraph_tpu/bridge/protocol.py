@@ -170,6 +170,16 @@ OP_STATE_FINGERPRINT = 21
 # a frame is one memcpy each way.
 OP_SHM_ATTACH = 22
 
+# FLEET_TALLY: u32 peer_id -> u32 n | n x (u32 state_code, u64 count).
+# The peer engine's slot-state histogram — for a federation host whose
+# peer engine is a fleet adapter this is the host's ONE-psum
+# fleet_state_counts; a plain engine answers its pool's local counts.
+# This is the fabric half of the cross-host tally contract: where the
+# backend implements cross-process collectives
+# (parallel.multihost.collectives_available) the fleet psums instead;
+# where it doesn't, a driver sums these frames across hosts.
+OP_FLEET_TALLY = 23
+
 # Opcodes that mutate server-side state (plus POLL_EVENTS, whose read is
 # DESTRUCTIVE — it drains the peer's event queue). On a pipelined
 # connection the server executes these in receive order per connection;
@@ -207,6 +217,11 @@ STATUS_UNKNOWN_PEER = 240
 STATUS_BAD_REQUEST = 241
 STATUS_UNKNOWN_OPCODE = 242
 STATUS_SYNC_STALE = 245  # requested snapshot_id no longer served
+# The scope's owning shard is frozen mid-migration to another host; the
+# response payload is the retry-after hint (seconds, decimal string).
+# Back off and retry — the placement flips within the window; votes are
+# never dropped, only deferred.
+STATUS_SHARD_MIGRATING = 246
 STATUS_INTERNAL = 250
 
 # GET_RESULT payload byte.
@@ -569,6 +584,20 @@ def encode_deliver_proposals(
         out.append(string(scope))
         out.append(blob(proposal))
     return b"".join(out)
+
+
+def encode_fleet_tally(counts: "dict[int, int]") -> bytes:
+    """``OP_FLEET_TALLY`` response payload: the slot-state histogram as
+    (state_code, count) pairs, code-sorted for a stable wire image."""
+    out = [u32(len(counts))]
+    for code in sorted(counts):
+        out.append(u32(int(code)) + u64(int(counts[code])))
+    return b"".join(out)
+
+
+def parse_fleet_tally(c: Cursor) -> "dict[int, int]":
+    """Decode an ``OP_FLEET_TALLY`` response into {state_code: count}."""
+    return {c.u32(): c.u64() for _ in range(c.u32())}
 
 
 # ── Socket tuning ──────────────────────────────────────────────────────

@@ -47,6 +47,7 @@ from ..obs import (
 )
 from ..obs import registry as default_registry
 from ..obs.trace import trace_store, use_context
+from ..parallel.fleet import ShardRecoveringError
 from ..signing import ConsensusSignatureScheme
 from ..signing.ethereum import EthereumConsensusSigner
 from ..types import (
@@ -838,6 +839,13 @@ class BridgeServer:
             return self._dispatch(opcode, cursor, vote_prep)
         except ConsensusError as exc:
             return int(exc.code), P.string(str(exc))
+        except ShardRecoveringError as exc:
+            # A federation host's shard frozen mid-migration (or mid-
+            # recovery): typed retry-after on the wire instead of an
+            # internal error — the sender backs off and replays, so a
+            # migration window never drops votes.
+            retry = getattr(exc, "retry_after", 1.0)
+            return P.STATUS_SHARD_MIGRATING, P.string(f"{retry}")
         except (ValueError, KeyError, struct_error) as exc:
             flight_recorder.record(
                 "bridge.bad_request", opcode=opcode, error=str(exc)
@@ -1050,6 +1058,25 @@ class BridgeServer:
         with self._lock:
             peer = self._peers.get(peer_id)
             return None if peer is None else peer.engine
+
+    def remove_peer(self, peer_id: int) -> None:
+        """Unregister a peer WITHOUT closing its engine (the caller owns
+        it — the federation's migration source registers a shard engine
+        as a temporary sync peer and retires it after the placement
+        flip). In-flight requests racing the removal answer
+        STATUS_UNKNOWN_PEER, the same as any never-registered id; the
+        peer's cached snapshot artifacts (if any) are dropped."""
+        with self._lock:
+            if self._peers.pop(peer_id, None) is None:
+                raise ValueError(f"unknown peer {peer_id}")
+        with self._sync_lock:
+            cached = self._sync_cache.pop(peer_id, None)
+            self._sync_gates.pop(peer_id, None)
+        if cached is not None:
+            try:
+                os.remove(cached[1])
+            except OSError:
+                pass
 
     def recovery_stats(self, identity: bytes):
         """:class:`~hashgraph_tpu.wal.ReplayStats` from the WAL recovery
@@ -1309,30 +1336,13 @@ class BridgeServer:
     @staticmethod
     def _pack_rows(view, cols, rows: np.ndarray):
         """Pack a peer's (possibly non-contiguous) rows into one
-        contiguous (data, offsets, cols) triple — vectorized gather, the
-        offset columns rebased. Multi-peer frames only; a single-peer
-        frame reuses the original views copy-free."""
+        contiguous (data, offsets, cols) triple (``columnar.pack_rows``,
+        shared with the federation adapter's per-shard packing).
+        Multi-peer frames only; a single-peer frame reuses the original
+        views copy-free."""
         from . import columnar as WC
 
-        starts = view.offsets[rows]
-        lens = view.offsets[rows + 1] - starts
-        offsets = np.zeros(len(rows) + 1, np.int64)
-        np.cumsum(lens, out=offsets[1:])
-        total = int(offsets[-1])
-        gather = (
-            np.arange(total, dtype=np.int64)
-            - np.repeat(offsets[:-1], lens)
-            + np.repeat(starts, lens)
-        )
-        data = view.data[gather]
-        sub = cols[rows].copy()
-        delta = offsets[:-1] - starts
-        for col in (
-            WC.COL_OWNER_OFF, WC.COL_PARENT_OFF, WC.COL_RECV_OFF,
-            WC.COL_HASH_OFF, WC.COL_SIG_OFF,
-        ):
-            sub[:, col] += delta
-        return data, offsets, sub
+        return WC.pack_rows(view.data, view.offsets, cols, rows)
 
     def _vote_batch_apply(self, prep: "_WireFramePrep") -> tuple[int, bytes]:
         """Stage 3 of the wire pipeline (serial lane, receive order):
@@ -1665,6 +1675,19 @@ class BridgeServer:
 
         return P.STATUS_OK, P.string(state_fingerprint(peer.engine))
 
+    def _op_fleet_tally(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
+        """Slot-state histogram of the peer's engine. A federation host
+        (peer engine = fleet adapter) answers its whole local fleet's
+        ONE-psum tally; a plain engine answers its pool's counts. This is
+        the fabric arm of the cross-host tally contract — the psum arm
+        needs cross-process collectives the backend may not implement
+        (parallel.multihost.collectives_available)."""
+        tally = getattr(peer.engine, "fleet_state_counts", None)
+        counts = tally() if tally is not None else peer.engine.pool().state_counts()
+        return P.STATUS_OK, P.encode_fleet_tally(
+            {int(code): int(count) for code, count in counts.items()}
+        )
+
     def _op_explain(self, peer: _Peer, c: P.Cursor) -> tuple[int, bytes]:
         """Decision provenance as one JSON blob (see
         ``TpuConsensusEngine.explain_decision``); durable peers overlay
@@ -1694,4 +1717,5 @@ _HANDLERS = {
     P.OP_WAL_TAIL: BridgeServer._op_wal_tail,
     P.OP_DELIVER_PROPOSALS: BridgeServer._op_deliver_proposals,
     P.OP_STATE_FINGERPRINT: BridgeServer._op_state_fingerprint,
+    P.OP_FLEET_TALLY: BridgeServer._op_fleet_tally,
 }

@@ -113,6 +113,29 @@ class VoteCoalescer:
             window = self._windows.get(peer_name)
             return window.votes if window is not None else 0
 
+    def extract(
+        self, peer_name: str, predicate
+    ) -> "list[tuple[int, str, list[bytes], int]]":
+        """Surgically remove the groups whose scope satisfies
+        ``predicate(scope)`` from ``peer_name``'s open window, returning
+        ``(peer_id, scope, votes, window_now)`` tuples (insertion
+        order). The federation driver drains a migrating shard's queued
+        votes into its migration tail this way — the rest of the window
+        stays queued for its original destination."""
+        with self._lock:
+            window = self._windows.get(peer_name)
+            if window is None:
+                return []
+            out = []
+            for key in [k for k in window.groups if predicate(k[1])]:
+                votes = window.groups.pop(key)
+                window.votes -= len(votes)
+                window.bytes -= sum(len(v) for v in votes)
+                out.append((key[0], key[1], votes, window.now))
+            if not window.groups:
+                del self._windows[peer_name]
+            return out
+
     def _seal(self, peer_name: str, window: _Window):
         # Caller holds the lock. The payload is a SEGMENT LIST (frame
         # head + the buffered vote bytes objects, un-joined): the

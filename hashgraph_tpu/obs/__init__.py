@@ -181,6 +181,17 @@ FLEET_SHARDS_RECOVERING = "hashgraph_fleet_shards_recovering"
 FLEET_ROUTED_VOTES_TOTAL = "hashgraph_fleet_routed_votes_total"
 FLEET_SWEEP_SECONDS = "hashgraph_fleet_sweep_seconds"
 
+# Federated fleet (parallel.federation): live host count seen by each
+# participant, votes routed to remotely-owned scopes over the gossip
+# fabric, shard migrations completed, and end-to-end migration wall time
+# (freeze -> snapshot+tail adopt -> placement flip -> tail replay).
+FEDERATION_HOSTS = "hashgraph_federation_hosts"
+FEDERATION_REMOTE_ROUTED_VOTES_TOTAL = (
+    "hashgraph_federation_remote_routed_votes_total"
+)
+FEDERATION_MIGRATIONS_TOTAL = "hashgraph_federation_migrations_total"
+FEDERATION_MIGRATION_SECONDS = "hashgraph_federation_migration_seconds"
+
 # State sync (sync.client / bridge sync opcodes): snapshot chunks served
 # by the source, chunks received + WAL tail records applied by the
 # joiner, and the end-to-end catch-up wall time.
@@ -232,6 +243,7 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         WAL_FSYNC_SECONDS,
         WAL_RECOVER_SECONDS,
         FLEET_SWEEP_SECONDS,
+        FEDERATION_MIGRATION_SECONDS,
         SYNC_CATCHUP_SECONDS,
         DEVICE_VERIFY_SECONDS,
     ):
@@ -247,6 +259,7 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         VERIFY_POOL_QUEUE_DEPTH,
         FLEET_SHARDS,
         FLEET_SHARDS_RECOVERING,
+        FEDERATION_HOSTS,
         TRACKED_PEERS,
         EVIDENCE_RECORDS,
         STALE_PEERS,
@@ -280,6 +293,8 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         JAX_COMPILE_CACHE_HITS_TOTAL,
         JAX_COMPILE_CACHE_MISSES_TOTAL,
         FLEET_ROUTED_VOTES_TOTAL,
+        FEDERATION_REMOTE_ROUTED_VOTES_TOTAL,
+        FEDERATION_MIGRATIONS_TOTAL,
         SYNC_CHUNKS_SENT_TOTAL,
         SYNC_CHUNKS_RECEIVED_TOTAL,
         SYNC_TAIL_RECORDS_TOTAL,

@@ -6,19 +6,32 @@ vectors are small); the host-validate → device-tally split is the pipeline
 axis. Collectives (psum over ICI) appear only in global aggregation.
 """
 
+from .federation import (
+    FederationDriver,
+    FederationPlacement,
+    FleetEngineAdapter,
+    FleetGroup,
+    MigrationError,
+    migrate_shard,
+    tally_path,
+)
 from .fleet import (
     ConsensusFleet,
     FleetShard,
     ScopePlacement,
+    ShardMigratingError,
     ShardRecoveringError,
     rendezvous_owner,
 )
 from .mesh import PROPOSAL_AXIS, consensus_mesh
 from .multihost import (
+    COLLECTIVES_GAP_SIGNATURE,
     MultiHostPool,
     agree_trace_context,
+    collectives_available,
     distributed_consensus_mesh,
     initialize_distributed,
+    is_collectives_gap,
     local_slot_range,
 )
 from .sharded import ShardedPool
@@ -32,9 +45,20 @@ __all__ = [
     "initialize_distributed",
     "distributed_consensus_mesh",
     "local_slot_range",
+    "collectives_available",
+    "is_collectives_gap",
+    "COLLECTIVES_GAP_SIGNATURE",
     "ConsensusFleet",
     "FleetShard",
     "ScopePlacement",
     "ShardRecoveringError",
+    "ShardMigratingError",
     "rendezvous_owner",
+    "FederationPlacement",
+    "FleetEngineAdapter",
+    "FleetGroup",
+    "FederationDriver",
+    "MigrationError",
+    "migrate_shard",
+    "tally_path",
 ]

@@ -71,6 +71,7 @@ __all__ = [
     "FleetShard",
     "ConsensusFleet",
     "ShardRecoveringError",
+    "ShardMigratingError",
 ]
 
 
@@ -83,6 +84,25 @@ class ShardRecoveringError(RuntimeError):
             "unavailable (other shards keep serving)"
         )
         self.shard_id = shard_id
+
+
+class ShardMigratingError(ShardRecoveringError):
+    """The scope's owning shard is mid-migration to another host.
+
+    A subclass of :class:`ShardRecoveringError` so existing
+    unavailability handling keeps working; ``retry_after`` carries the
+    migration orchestrator's hint of when routes resume on the new
+    owner — callers back off and retry instead of dropping votes (the
+    federation driver buffers them as the migration tail)."""
+
+    def __init__(self, shard_id: str, retry_after: float = 1.0):
+        RuntimeError.__init__(
+            self,
+            f"shard {shard_id!r} is migrating; its scopes resume on the "
+            f"new owner in ~{retry_after:.1f}s (retry with backoff)",
+        )
+        self.shard_id = shard_id
+        self.retry_after = retry_after
 
 
 # ── Placement ──────────────────────────────────────────────────────────
@@ -184,11 +204,14 @@ class ScopePlacement:
             self._ids.append(shard_id)
             self._cache.clear()
 
-    def remove_shard(self, shard_id: str) -> None:
+    def remove_shard(self, shard_id: str, allow_empty: bool = False) -> None:
         with self._lock:
             if shard_id not in self._ids:
                 raise ValueError(f"shard {shard_id!r} not placed")
-            if len(self._ids) == 1:
+            if len(self._ids) == 1 and not allow_empty:
+                # A standalone fleet with zero shards can route nothing;
+                # only a federation host DRAINED by migration (its scopes
+                # live on other hosts now) legitimately reaches empty.
                 raise ValueError("cannot remove the last shard")
             self._ids.remove(shard_id)
             self._cache.clear()
@@ -212,6 +235,12 @@ class FleetShard:
         self.index = index
         self.lock = threading.RLock()
         self.recovering = False
+        # Migration freeze: the engine stays LIVE (it serves the snapshot
+        # + WAL tail the adopting host catches up from) but routes raise
+        # ShardMigratingError until the placement flips and the shard is
+        # retired (or end_migration aborts).
+        self.migrating = False
+        self.migration_retry_after = 1.0
         self.recovery_error: "BaseException | None" = None
         self.votes_routed = 0  # rows this shard was handed by the router
         # Last WAL replay's ReplayStats (recover_shard) — surfaced in
@@ -224,7 +253,11 @@ class FleetShard:
 
     @property
     def available(self) -> bool:
-        return not self.recovering and self.engine is not None
+        return (
+            not self.recovering
+            and not self.migrating
+            and self.engine is not None
+        )
 
     def health_report(self, now=None) -> dict:
         return self.engine.health_report(now)
@@ -416,10 +449,15 @@ class ConsensusFleet:
             self._tally_cache = None
             return shard_id
 
-    def remove_shard(self, shard_id: str, force: bool = False) -> None:
+    def remove_shard(
+        self, shard_id: str, force: bool = False, allow_empty: bool = False
+    ) -> None:
         """Elastic scale-in. Refuses while the shard still owns pinned
         (live) scopes unless ``force`` — draining live scopes is the
-        embedder's job (delete or snapshot-migrate them first)."""
+        embedder's job (delete or snapshot-migrate them first).
+        ``allow_empty`` permits removing the LAST shard: a federation
+        host whose final shard migrated away serves nothing until a
+        later ``add_shard`` (routes raise on the empty placement)."""
         with self._lock:
             pinned = [s for s, sid in self._pins.items() if sid == shard_id]
             if pinned and not force:
@@ -427,7 +465,7 @@ class ConsensusFleet:
                     f"shard {shard_id!r} still owns live scopes "
                     f"{pinned[:4]}...; drain them or pass force=True"
                 )
-            self.placement.remove_shard(shard_id)
+            self.placement.remove_shard(shard_id, allow_empty=allow_empty)
             shard = self._shards.pop(shard_id)
             for s in pinned:
                 del self._pins[s]
@@ -452,11 +490,20 @@ class ConsensusFleet:
             pinned = self._pins.get(scope)
         return pinned if pinned is not None else self.placement.owner(scope)
 
+    def _unavailable(self, sid: str) -> ShardRecoveringError:
+        """The typed unavailability for routes to shard ``sid``:
+        migrating shards carry the retry-after hint, everything else is
+        the recovery error."""
+        shard = self._shards[sid]
+        if shard.migrating:
+            return ShardMigratingError(sid, shard.migration_retry_after)
+        return ShardRecoveringError(sid)
+
     def _shard_for(self, scope, pin: bool = False) -> FleetShard:
         sid = self.owner_of(scope)
         shard = self._shards[sid]
         if not shard.available:
-            raise ShardRecoveringError(sid)
+            raise self._unavailable(sid)
         if pin:
             with self._lock:
                 self._pins.setdefault(scope, sid)
@@ -472,7 +519,7 @@ class ConsensusFleet:
         AttributeError on a None engine."""
         engine = self._shards[sid].engine
         if engine is None:
-            raise ShardRecoveringError(sid)
+            raise self._unavailable(sid)
         return engine
 
     # Control plane — routed scope-granular passthroughs. Mutating entry
@@ -547,7 +594,7 @@ class ConsensusFleet:
             sid = self.owner_of(scope)
             if not self._shards[sid].available:
                 if not unavailable_ok:
-                    raise ShardRecoveringError(sid)
+                    raise self._unavailable(sid)
                 down.add(k)
                 continue
             groups.setdefault(sid, []).append((k, scope))
@@ -666,7 +713,7 @@ class ConsensusFleet:
             sid = self.owner_of(scope)
             if not self._shards[sid].available:
                 if not unavailable_ok:
-                    raise ShardRecoveringError(sid)
+                    raise self._unavailable(sid)
                 continue
             groups.setdefault(sid, []).append(k)
 
@@ -704,7 +751,7 @@ class ConsensusFleet:
             for k, (scope, _) in enumerate(items):
                 sid = self.owner_of(scope)
                 if not self._shards[sid].available:
-                    raise ShardRecoveringError(sid)
+                    raise self._unavailable(sid)
                 per_shard.setdefault(
                     sid, [[] for _ in batches]
                 )[b].append(k)
@@ -906,7 +953,8 @@ class ConsensusFleet:
         for sid, shard in self._shards.items():
             if not shard.available:
                 out[sid] = {
-                    "recovering": True,
+                    "recovering": shard.recovering or shard.engine is None,
+                    "migrating": shard.migrating,
                     "recovery_error": (
                         repr(shard.recovery_error)
                         if shard.recovery_error is not None
@@ -933,7 +981,8 @@ class ConsensusFleet:
         for sid, shard in self._shards.items():
             if not shard.available:
                 out[sid] = {
-                    "recovering": True,
+                    "recovering": shard.recovering or shard.engine is None,
+                    "migrating": shard.migrating,
                     "recovery_error": (
                         repr(shard.recovery_error)
                         if shard.recovery_error is not None
@@ -945,6 +994,39 @@ class ConsensusFleet:
             report.update(self._recovery_overlay(shard))
             out[sid] = report
         return out
+
+    # ── Migration freeze (re-homing onto another host) ─────────────────
+
+    def begin_migration(
+        self, shard_id: str, retry_after: float = 1.0
+    ) -> None:
+        """Freeze a shard for re-homing: the engine stays LIVE so the
+        bridge can serve its consistent snapshot + WAL tail to the
+        adopting host, but every route raises
+        :class:`ShardMigratingError` (with ``retry_after`` as the
+        caller's backoff hint) until :meth:`end_migration` aborts or
+        ``remove_shard`` retires the shard after the placement flip."""
+        shard = self._shards[shard_id]
+        if shard.engine is None or shard.recovering:
+            raise ValueError(f"shard {shard_id!r} is not serving")
+        shard.migration_retry_after = retry_after
+        shard.migrating = True
+
+    def end_migration(self, shard_id: str) -> None:
+        """Abort a migration freeze: the shard resumes serving locally
+        (the placement never flipped, so no state moved)."""
+        self._shards[shard_id].migrating = False
+
+    def pin_scope(self, scope, shard_id: str) -> None:
+        """Pin ``scope`` to ``shard_id`` explicitly. The adopting side
+        of a shard migration uses this to mirror the source fleet's
+        live-scope pins: the migrated sessions live on the adopted shard
+        regardless of where this fleet's own rendezvous would have
+        placed them."""
+        if shard_id not in self._shards:
+            raise ValueError(f"unknown shard {shard_id!r}")
+        with self._lock:
+            self._pins[scope] = shard_id
 
     # ── Crash / recovery ───────────────────────────────────────────────
 

@@ -49,8 +49,66 @@ __all__ = [
     "distributed_consensus_mesh",
     "local_slot_range",
     "agree_trace_context",
+    "collectives_available",
+    "is_collectives_gap",
+    "COLLECTIVES_GAP_SIGNATURE",
     "MultiHostPool",
 ]
+
+
+# The exact backend-gap signature raised by jaxlib CPU backends that
+# implement no multi-process collectives (sharded computations across
+# jax.distributed processes fail at dispatch with this message). It is
+# BOTH the runtime capability probe's discriminator (see
+# collectives_available) and the only failure the two subprocess
+# integration tests in tests/test_multihost.py may skip on — anything
+# else still fails them.
+COLLECTIVES_GAP_SIGNATURE = (
+    "Multiprocess computations aren't implemented on the CPU backend"
+)
+
+
+def is_collectives_gap(exc: "BaseException | str") -> bool:
+    """Whether an exception (or its message) is the known CPU-backend
+    multi-process collectives gap — the one condition under which the
+    fleet demotes cross-host tallies from psum to fabric frames."""
+    return COLLECTIVES_GAP_SIGNATURE in str(exc)
+
+
+_collectives_probe: "bool | None" = None
+
+
+def collectives_available(refresh: bool = False) -> bool:
+    """Runtime capability probe: can this process run cross-process
+    collectives?
+
+    Single-process (no ``jax.distributed`` fleet): trivially True — every
+    collective is an in-process reduction, which all backends implement.
+    Multi-process: run ONE tiny allgather and catch the CPU-backend gap
+    signature (:data:`COLLECTIVES_GAP_SIGNATURE`). This is the runtime
+    analogue of what used to be a test-only skip-guard: the federation
+    tally path consults it to pick real psum collectives where the
+    backend supports them and the gossip fabric's ``OP_FLEET_TALLY``
+    frames where it doesn't. Any OTHER failure re-raises — a real bug
+    must not silently demote the tally path.
+
+    Memoized (a backend cannot gain the capability mid-process);
+    ``refresh=True`` re-probes."""
+    global _collectives_probe
+    if _collectives_probe is not None and not refresh:
+        return _collectives_probe
+    if jax.process_count() <= 1:
+        _collectives_probe = True
+        return True
+    try:
+        multihost_utils.process_allgather(np.ones(1, np.int32))
+    except Exception as exc:
+        if is_collectives_gap(exc):
+            _collectives_probe = False
+            return False
+        raise
+    _collectives_probe = True
+    return True
 
 
 def initialize_distributed(

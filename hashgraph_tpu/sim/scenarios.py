@@ -339,6 +339,61 @@ def _columnar_wire_storm(c: SimCluster):
     }
 
 
+def _roll_deploy(c: SimCluster):
+    """Rolling deploy: restart every host ONE AT A TIME under sustained
+    traffic — each peer in turn is kill-9'd (WAL handles abandoned, no
+    final fsync) and brought back through real WAL recovery while the
+    survivors keep creating and deciding sessions. The federation
+    acceptance shape: zero lost decisions (every session decides, and
+    identically, on every peer) and cross-host fingerprint equality
+    after the LAST heal."""
+    pre = c.create_session(c.peer(0), "pre")
+    c.vote_all(pre)
+    n = len(c.peers)
+    recoveries = []
+    for k in range(n):
+        victim = c.peer(k)
+        # Traffic DURING the roll: a session created before the restart
+        # reaches quorum among the other peers (ceil(2n/3) of n needs no
+        # single fixed voter), one created while the victim is down is
+        # ferried around it, and both must repair onto the restarted
+        # peer afterwards.
+        rolling = c.create_session(c.peer((k + 1) % n), f"roll-{k}")
+        for i, peer in enumerate(c.peers):
+            if peer is victim or peer.crashed:
+                continue
+            c.cast_vote(rolling, peer, True)
+        victim.crash()
+        while_down = c.create_session(c.peer((k + 1) % n), f"down-{k}")
+        c.vote_all(while_down)
+        victim.restart()  # the real ADD_PEER -> recover() replay path
+        recoveries.append(victim.last_recovery)
+        c.anti_entropy_round()
+    heal = c.converge()
+    # Zero lost decisions: every session created during the roll is
+    # DECIDED, identically, on every (now live) peer.
+    lost = []
+    for session in c.sessions:
+        results = c.results(session)
+        values = set(results.values())
+        if len(values) != 1 or not isinstance(next(iter(values)), bool):
+            lost.append({session.scope: results})
+    return {}, {
+        "every_host_restarted": all(p.restarts >= 1 for p in c.peers),
+        "recoveries_clean": all(
+            r is not None and not r.errors and r.segments_dropped == 0
+            for r in recoveries
+        ),
+        "zero_lost_decisions": not lost,
+        "healed_after_last_restart": heal["ok"],
+    }, {
+        "restarts": [p.restarts for p in c.peers],
+        "sessions": len(c.sessions),
+        "heal_rounds": heal["rounds"],
+        "lost": lost[:4],
+    }
+
+
 def _timeout_liveness(c: SimCluster):
     # expected_voters past the live peer count: the session can only
     # decide through the embedder's timeout duty.
@@ -383,6 +438,10 @@ SCENARIOS: "dict[str, _Spec]" = {
     # path itself, so the HASHGRAPH_TPU_WIRE_COLUMNAR env override must
     # not be able to change what it measures.
     "columnar-wire-storm": _Spec(_columnar_wire_storm, wire_columnar=True),
+    # Rolling restart of every host, one at a time, under traffic — the
+    # federation roll-deploy acceptance: zero lost decisions plus
+    # cross-host fingerprint equality after the last heal.
+    "roll-deploy": _Spec(_roll_deploy),
     "timeout-liveness": _Spec(_timeout_liveness),
 }
 

@@ -418,10 +418,50 @@ def _run_two_process(tmp_path, script, marker):
 
 
 # The exact backend-gap signature: anything else (an assertion failure in
-# the worker, a crash, a timeout) must still FAIL the test.
-_CPU_COLLECTIVES_UNIMPLEMENTED = (
-    "Multiprocess computations aren't implemented on the CPU backend"
+# the worker, a crash, a timeout) must still FAIL the test. ONE home:
+# parallel/multihost.py owns the string because the runtime capability
+# probe (collectives_available) discriminates on the same signature —
+# what used to be a test-only skip-guard is now the production
+# psum-vs-fabric tally path selector.
+from hashgraph_tpu.parallel.multihost import (  # noqa: E402
+    COLLECTIVES_GAP_SIGNATURE as _CPU_COLLECTIVES_UNIMPLEMENTED,
 )
+
+
+def test_collectives_probe_single_process():
+    """On a single-process backend the probe is trivially True (every
+    collective is an in-process reduction) and memoizes."""
+    from hashgraph_tpu.parallel.multihost import collectives_available
+
+    assert collectives_available(refresh=True) is True
+    assert collectives_available() is True  # memoized path
+
+
+def test_collectives_gap_signature_matcher():
+    """The discriminator accepts exceptions and strings, matches only
+    the known backend-gap signature, and never a generic failure."""
+    from hashgraph_tpu.parallel import multihost as mh
+
+    wrapped = RuntimeError(
+        "INVALID_ARGUMENT: " + mh.COLLECTIVES_GAP_SIGNATURE + " (dispatch)"
+    )
+    assert mh.is_collectives_gap(wrapped)
+    assert mh.is_collectives_gap(mh.COLLECTIVES_GAP_SIGNATURE)
+    assert not mh.is_collectives_gap(RuntimeError("connection refused"))
+    assert not mh.is_collectives_gap(ValueError("shape mismatch"))
+
+
+def test_collectives_probe_drives_federation_tally_path():
+    """The federation's tally-path selector consults the probe: on this
+    single-process CPU backend there is no cross-process jax fleet, so
+    cross-host tallies must ride the gossip fabric's OP_FLEET_TALLY
+    frames, not psum."""
+    import jax
+
+    from hashgraph_tpu.parallel.federation import tally_path
+
+    assert jax.process_count() == 1
+    assert tally_path() == "fabric"
 
 
 def test_two_process_engine_on_multihost_pool(tmp_path):
