@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-federation bench-catchup bench-gossip bench-chaos bench-device-verify fleet-smoke federation-smoke catchup-smoke gossip-smoke chaos-smoke metrics-smoke trace-smoke smoke
+.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-federation bench-catchup bench-gossip bench-chaos bench-churn bench-device-verify fleet-smoke federation-smoke catchup-smoke gossip-smoke chaos-smoke churn-smoke metrics-smoke trace-smoke smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -109,6 +109,18 @@ bench-chaos:
 # `scenarios: {passed, failed, seeds}` block.
 chaos-smoke:
 	JAX_PLATFORMS=cpu python bench.py chaos --smoke
+
+# Tiered-session-lifecycle churn bench: 10M+ cumulative sessions through
+# a fixed-size engine with per-wave asserted RSS + device-slot + tier
+# ceilings (demote -> demand-page -> GC), paired same-window A/B against
+# an untier'd delete_scope arm with a machine-readable noise_verdict.
+bench-churn:
+	JAX_PLATFORMS=cpu python bench.py churn
+
+# CI short run: the same lifecycle (ceiling asserts ON, A/B included) at
+# a bounded cumulative-session count.
+churn-smoke:
+	JAX_PLATFORMS=cpu python bench.py churn --smoke
 
 # Device-vs-host-pool Ed25519 batch-verify A/B (the crypto_device
 # subsystem): same signed corpus through both verify_batch backends,

@@ -2180,6 +2180,255 @@ def run_chaos(smoke: bool = False, seeds: "list[int] | None" = None) -> dict:
     }
 
 
+def _rss_bytes() -> int:
+    """Resident set size of this process (Linux /proc, no psutil dep)."""
+    with open("/proc/self/status") as fh:
+        for line in fh:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) * 1024
+    raise RuntimeError("VmRSS not found in /proc/self/status")
+
+
+def run_churn(
+    smoke: bool = False,
+    target_sessions: "int | None" = None,
+    scopes: int = 16,
+    per_scope: int = 256,
+    v_count: int = 4,
+) -> dict:
+    """Tiered session-lifecycle churn: 10M+ CUMULATIVE sessions through a
+    fixed-size engine under a HARD, asserted RSS + device-slot ceiling.
+
+    Every wave creates ``scopes × per_scope`` fresh sessions
+    (create_proposals_multi), decides them all with exactly the quorum's
+    worth of columnar votes, advances the logical clock one tick, and
+    runs the engine's ``sweep_timeouts`` — whose lifecycle hook demotes
+    decided sessions to the serialized tier after ``demote_after`` ticks
+    and garbage-collects them ``evict_decided_after`` ticks after their
+    deciding activity. The working set (live sessions + tier population
+    + RSS) is asserted bounded on EVERY wave, so the 10M headline is a
+    held ceiling, not an observation.
+
+    The throughput claim rides the repo's paired same-window A/B: the
+    tiered lifecycle arm vs an untier'd arm running the identical
+    create/vote traffic with the reference's only lifecycle
+    (delete_scope after every wave), interleaved T/U within one window,
+    with a machine-readable ``noise_verdict`` gating "steady-state
+    ingest within 2x of the untier'd arm".
+    """
+    import jax
+
+    from hashgraph_tpu import CreateProposalRequest, ScopeConfig, StubConsensusSigner
+    from hashgraph_tpu.engine import TpuConsensusEngine
+
+    now0 = 1_700_000_000
+    wave_sessions = scopes * per_scope
+    if target_sessions is None:
+        target_sessions = 60_000 if smoke else 10_000_000
+    demote_after, evict_after = 2.0, 4.0
+    # Ceilings (hard asserts, not observations). Live: waves still inside
+    # the demotion window plus the in-flight wave. Tier: waves between
+    # demotion and GC. RSS: growth budget over the post-warmup baseline.
+    live_ceiling = wave_sessions * (int(demote_after) + 2)
+    tier_ceiling = wave_sessions * (int(evict_after - demote_after) + 2)
+    capacity = live_ceiling  # the device-slot ceiling: pool cannot exceed it
+    rss_budget = (512 if not smoke else 384) * 1024 * 1024
+    scope_names = [f"s{i}" for i in range(scopes)]
+    owners = [bytes([1 + i]) * 20 for i in range(v_count)]
+    # Exactly the quorum's worth of YES votes per session (div_ceil(2n,3)
+    # under the gossipsub default): every vote is an accept, every session
+    # decides on its last vote — no ALREADY_REACHED extras in the timing.
+    present = -(-2 * v_count // 3)
+    requests = [
+        CreateProposalRequest(
+            name="p",
+            payload=b"",
+            proposal_owner=b"o",
+            expected_voters_count=v_count,
+            expiration_timestamp=now0 + 100_000_000,
+            liveness_criteria_yes=True,
+        )
+        for _ in range(per_scope)
+    ]
+
+    def make_engine(tiered: bool) -> TpuConsensusEngine:
+        engine = TpuConsensusEngine(
+            StubConsensusSigner(b"\x01" * 20),
+            capacity=capacity,
+            voter_capacity=v_count,
+            max_sessions_per_scope=live_ceiling + tier_ceiling,
+        )
+        config = ScopeConfig(
+            demote_after=demote_after if tiered else None,
+            evict_decided_after=evict_after if tiered else None,
+        )
+        for scope in scope_names:
+            engine.set_scope_config(scope, config.clone())
+        return engine
+
+    def run_wave(engine, now: int, tiered: bool) -> int:
+        """One churn wave; returns votes applied."""
+        gids = np.array([engine.voter_gid(o) for o in owners], np.int64)
+        batches = engine.create_proposals_multi(
+            [(scope, requests) for scope in scope_names], now
+        )
+        all_pids = []
+        scope_of = []
+        for k, proposals in enumerate(batches):
+            all_pids.extend(p.proposal_id for p in proposals)
+            scope_of.extend([k] * len(proposals))
+        pids = np.array(all_pids, np.int64)
+        sidx = np.array(scope_of, np.int64)
+        col_pids = np.repeat(pids, present)
+        col_sidx = np.repeat(sidx, present)
+        col_gids = np.tile(gids[:present], wave_sessions)
+        col_vals = np.ones(wave_sessions * present, bool)
+        statuses = engine.ingest_columnar_multi(
+            scope_names, col_sidx, col_pids, col_gids, col_vals, now
+        )
+        # Correctness gate every wave: an unresolved session (20) or a
+        # stale voter identity (10) is a lifecycle bug, not throughput.
+        assert int(np.sum(statuses != 0)) == 0, (
+            "churn wave rejected votes: "
+            + str(np.unique(statuses[statuses != 0]))
+        )
+        if tiered:
+            engine.sweep_timeouts(now)  # lifecycle hook: demote + GC
+        else:
+            engine.delete_scopes(scope_names)  # the reference's lifecycle
+            config = ScopeConfig()
+            for scope in scope_names:
+                engine.set_scope_config(scope, config.clone())
+        return len(statuses)
+
+    # ── Paired same-window A/B (steady-state rate, small windows) ──────
+    window_waves = 3 if smoke else 6
+    reps = 3 if smoke else 5
+    arm_t = make_engine(tiered=True)
+    arm_u = make_engine(tiered=False)
+    # Warmup both arms through the full lifecycle (compile + steady tier).
+    warm = int(demote_after + evict_after) + 1
+    now_t = now_u = now0
+    for _ in range(warm):
+        run_wave(arm_t, now_t, True)
+        now_t += 1
+        run_wave(arm_u, now_u, False)
+        now_u += 1
+    t_rates: list[float] = []
+    u_rates: list[float] = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        votes = 0
+        for _ in range(window_waves):
+            votes += run_wave(arm_t, now_t, True)
+            now_t += 1
+        t_rates.append(votes / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        votes = 0
+        for _ in range(window_waves):
+            votes += run_wave(arm_u, now_u, False)
+            now_u += 1
+        u_rates.append(votes / (time.perf_counter() - t0))
+    med_t = sorted(t_rates)[len(t_rates) // 2]
+    med_u = sorted(u_rates)[len(u_rates) // 2]
+    slowdown = med_u / med_t if med_t else float("inf")
+    max_spread = max(spread_pct(t_rates), spread_pct(u_rates))
+    within_2x = slowdown <= 2.0
+    noise_verdict = {
+        "pass": bool(within_2x),
+        "criterion": (
+            "median tiered-arm ingest rate within 2x of the untier'd "
+            "paired arm, same window, interleaved reps"
+        ),
+        "tiered_votes_per_sec": round(med_t, 1),
+        "untiered_votes_per_sec": round(med_u, 1),
+        "slowdown_vs_untiered": round(slowdown, 3),
+        "tiered_reps": [round(r, 1) for r in t_rates],
+        "untiered_reps": [round(r, 1) for r in u_rates],
+        "spread_pct": {
+            "tiered": spread_pct(t_rates),
+            "untiered": spread_pct(u_rates),
+        },
+        "max_spread_pct": max_spread,
+    }
+    assert within_2x, (
+        f"tiered steady-state ingest {med_t:.0f}/s is more than 2x below "
+        f"the untier'd arm {med_u:.0f}/s"
+    )
+    del arm_u
+
+    # ── Headline: cumulative sessions under the asserted ceilings ──────
+    engine = arm_t  # continue the warmed tiered engine
+    cumulative = warm * wave_sessions + reps * window_waves * wave_sessions
+    # The headline loop must actually run (and sample its ceilings) even
+    # at smoke scale, on top of whatever the warmup + A/B consumed.
+    target_sessions = max(target_sessions, cumulative + 20 * wave_sessions)
+    votes_total = cumulative * present
+    import gc as _gc
+
+    _gc.collect()
+    rss0 = _rss_bytes()
+    rss_peak = 0
+    occ_peak = {"live_sessions": 0, "tier_sessions": 0, "tier_bytes": 0}
+    start = time.perf_counter()
+    while cumulative < target_sessions:
+        votes_total += run_wave(engine, now_t, True)
+        now_t += 1
+        cumulative += wave_sessions
+        # EVERY ceiling asserts on EVERY wave — the headline is a held
+        # bound, not an average that can hide a transient overshoot.
+        rss = _rss_bytes()
+        rss_peak = max(rss_peak, rss)
+        assert rss - rss0 <= rss_budget, (
+            f"RSS ceiling broken at {cumulative} cumulative sessions: "
+            f"{(rss - rss0) / 1e6:.1f} MB over a "
+            f"{rss_budget / 1e6:.0f} MB budget"
+        )
+        occ = engine.occupancy()
+        for key in occ_peak:
+            occ_peak[key] = max(occ_peak[key], occ[key])
+        assert occ["device_slots_used"] <= capacity
+        assert occ["live_sessions"] <= live_ceiling, occ
+        assert occ["tier_sessions"] <= tier_ceiling, occ
+    elapsed = time.perf_counter() - start
+    occ = engine.occupancy()
+    return {
+        "metric": "churn_cumulative_sessions",
+        "value": cumulative,
+        "unit": "sessions",
+        "detail": {
+            "wave_sessions": wave_sessions,
+            "scopes": scopes,
+            "voters_per_session": v_count,
+            "votes_per_session": present,
+            "votes_total": votes_total,
+            "headline_seconds": round(elapsed, 3),
+            "sessions_per_sec": round(
+                (cumulative - warm * wave_sessions
+                 - reps * window_waves * wave_sessions) / elapsed, 1
+            ),
+            "ceilings": {
+                "device_slots": capacity,
+                "live_sessions": live_ceiling,
+                "tier_sessions": tier_ceiling,
+                "rss_budget_bytes": rss_budget,
+                "asserted_every_wave": True,
+            },
+            "observed_peaks": {
+                "rss_over_baseline_bytes": max(rss_peak - rss0, 0),
+                **occ_peak,
+            },
+            "final_occupancy": occ,
+            "policy": {
+                "demote_after_ticks": demote_after,
+                "evict_decided_after_ticks": evict_after,
+            },
+            "noise_verdict": noise_verdict,
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
 def run_gossip(
     n_peers: int = 4,
     p_count: int = 8,
@@ -3504,6 +3753,7 @@ if __name__ == "__main__":
         "catchup": lambda: run_catchup(smoke=fleet_smoke),
         "gossip": lambda: run_gossip(smoke=fleet_smoke, stages=gossip_stages),
         "chaos": lambda: run_chaos(smoke=fleet_smoke),
+        "churn": lambda: run_churn(smoke=fleet_smoke),
         "default": run_default,
     }
     def _registry_snapshot() -> dict:

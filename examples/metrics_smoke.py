@@ -71,6 +71,16 @@ REQUIRED_FAMILIES = [
     "hashgraph_sync_chunks_received_total",
     "hashgraph_sync_tail_records_total",
     "hashgraph_sync_catchup_seconds_bucket",
+    # Tiered-session-lifecycle families: demoted-tier population/bytes
+    # gauges plus demotion/promotion/GC counters. Eagerly installed — an
+    # untier'd node's dashboard must still see them (at 0) before any
+    # scope opts into TTL policies; the traffic is exercised by
+    # `bench.py churn` and tests/test_tiering.py.
+    "hashgraph_tier_demoted_sessions",
+    "hashgraph_tier_bytes",
+    "hashgraph_tier_demotions_total",
+    "hashgraph_tier_promotions_total",
+    "hashgraph_tier_gc_total",
     # Federated fleet families: hosts gauge, votes routed to remotely
     # owned scopes over the fabric, shard migrations + their wall time.
     # Eagerly installed — a single-host node's dashboard must still see

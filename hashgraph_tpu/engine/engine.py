@@ -50,6 +50,11 @@ from ..obs import (
     INGEST_BATCH_SIZE,
     LIVE_PROPOSALS,
     PROPOSALS_CREATED_TOTAL,
+    TIER_BYTES,
+    TIER_DEMOTED_SESSIONS,
+    TIER_DEMOTIONS_TOTAL,
+    TIER_GC_TOTAL,
+    TIER_PROMOTIONS_TOTAL,
     TIMEOUTS_FIRED_TOTAL,
     VERIFIED_SIGNATURES_TOTAL,
     VERIFY_BATCH_SECONDS,
@@ -228,6 +233,13 @@ class SessionRecord(Generic[Scope]):
     # batch path): every later span/instant for this session joins this
     # trace, and the wire layers serialize it alongside the proposal.
     trace: "TraceContext | None" = None
+    # Tiered-lifecycle bookkeeping: logical timestamp of the session's
+    # last accepted activity (registration, accepted vote, fired timeout
+    # — the idle clock the per-scope ``demote_after`` / GC TTLs measure
+    # against), and the per-scope registration sequence number that keeps
+    # LRU tie-order identical across demote/promote round-trips.
+    last_activity: int = 0
+    seq: int = 0
 
     @classmethod
     def fresh_pooled(
@@ -254,6 +266,8 @@ class SessionRecord(Generic[Scope]):
         rec.wire_sync = None
         rec.wire_only = True
         rec.trace = None
+        rec.last_activity = created_at
+        rec.seq = 0
         return rec
 
     def next_arrival_seq(self) -> int:
@@ -324,6 +338,36 @@ class WireVotePrepass:
             self._result = self._collect_fn()
             self._collect_fn = None
         return self._result
+
+
+class _TierEntry:
+    """One demoted session: the exact PR-8 snapshot ITEM_SESSION payload
+    bytes (:func:`hashgraph_tpu.sync.snapshot.encode_session_item` — the
+    canonical serialized session, signed vote wire included, so promotion
+    needs no re-signing and ``state_fingerprint`` hashes the same item
+    bytes whether a session is live or demoted) plus the scalar metadata
+    reads need WITHOUT decoding: lifecycle state for stats, created_at +
+    seq for LRU ranking, expiry for the timeout sweep, last_activity for
+    the GC TTL."""
+
+    __slots__ = (
+        "item",
+        "state",  # snapshot state code: 0 active / 1 reached / 2 failed
+        "result",  # meaningful iff state == 1
+        "created_at",
+        "seq",
+        "expiry",
+        "last_activity",
+    )
+
+    def __init__(self, item, state, result, created_at, seq, expiry, last_activity):
+        self.item = item
+        self.state = state
+        self.result = result
+        self.created_at = created_at
+        self.seq = seq
+        self.expiry = expiry
+        self.last_activity = last_activity
 
 
 # Sentinel: "compute the signature prepass inside ingest_votes" (the
@@ -477,6 +521,22 @@ class TpuConsensusEngine(Generic[Scope]):
         self.metrics.register_gauge(
             VOTE_TABLE_OCCUPANCY, _pool_occupancy, owner=self
         )
+
+        def _tier_sessions() -> int:
+            engine = ref()
+            return engine._tier_count if engine is not None else 0
+
+        def _tier_bytes() -> int:
+            engine = ref()
+            return engine._tier_bytes if engine is not None else 0
+
+        self.metrics.register_gauge(
+            TIER_DEMOTED_SESSIONS, _tier_sessions, owner=self
+        )
+        self.metrics.register_gauge(TIER_BYTES, _tier_bytes, owner=self)
+        self._m_tier_demotions = self.metrics.counter(TIER_DEMOTIONS_TOTAL)
+        self._m_tier_promotions = self.metrics.counter(TIER_PROMOTIONS_TOTAL)
+        self._m_tier_gc = self.metrics.counter(TIER_GC_TOTAL)
         # Device/XLA telemetry (live-buffer gauge provider is global;
         # this routes the persistent-compile-cache monitoring events onto
         # the registry). Idempotent, and this module already imports JAX
@@ -505,6 +565,41 @@ class TpuConsensusEngine(Generic[Scope]):
         # outright (_drop_pid_cache) — cheaper than tracking which scopes
         # each tuple spans, and rebuilds are one vectorized pass.
         self._fused_pid_cache: dict[tuple, "_PidLookup"] = {}
+        # ── Demoted session tier (storage tiering, ROADMAP item 5) ─────
+        # scope -> {pid -> _TierEntry}: sessions moved out of their device
+        # slot / host record into the compact serialized tier (the PR-8
+        # snapshot item format). Insertion order per scope = demotion
+        # order. Demoted sessions stay fully addressable — every public
+        # read/mutation either pages them back in (_promote_key) or reads
+        # through the tier without promoting (stats, enumerations,
+        # save_to_storage), so callers observe an untier'd engine.
+        self._tier: dict[Scope, dict[int, _TierEntry]] = {}
+        self._tier_count = 0
+        self._tier_bytes = 0
+        # ACTIVE demoted sessions only, (scope, pid) -> expiry: the
+        # timeout sweep must page an expired idle session back in to fire
+        # its timeout; keeping this tiny side map means the sweep never
+        # scans the (potentially huge) decided-session tier.
+        self._tier_active: dict[tuple[Scope, int], int] = {}
+        # Per-scope demoted-pid arrays for batch id draws (invalidated on
+        # tier membership change; rebuilt lazily by _taken_pids).
+        self._tier_pid_arrays: dict[Scope, np.ndarray] = {}
+        # Scopes excluded from the lifecycle sweep's demote/GC policies
+        # (fleet/federation pin a scope while migrating its shard so the
+        # routers never page state mid-flip).
+        self._pinned_scopes: set[Scope] = set()
+        # Per-scope registration sequence (LRU tie order across tiers).
+        self._scope_seq: dict[Scope, int] = {}
+        # Reentrancy flag: promotion re-registers a session through
+        # _register, which must not count it as a fresh proposal.
+        self._promoting = False
+        # Lifecycle gate (set_replay_mode): False during WAL replay.
+        self._lifecycle_live = True
+        # Engine-local tier traffic counts (occupancy() is per-engine;
+        # the hashgraph_tier_* counters are process-wide).
+        self._tier_demotions = 0
+        self._tier_promotions = 0
+        self._tier_gc = 0
 
     # ── Accessors ──────────────────────────────────────────────────────
 
@@ -526,6 +621,12 @@ class TpuConsensusEngine(Generic[Scope]):
         # re-recording them would double-count scorecards (evidence
         # itself dedups, but counters do not).
         self._health_live = not on
+        # The tier lifecycle pauses too: TTL decisions depend on idle
+        # clocks a snapshot restore does not carry, so replay must not
+        # re-derive them — the live run's GC outcome arrives as explicit
+        # KIND_GC records (applied via gc_sessions), and demotion is
+        # pure cache management recovery legitimately skips.
+        self._lifecycle_live = not on
         if on:
             # Throwaway instruments: the ingest paths inc attributes
             # unconditionally, so swapping the targets is cheaper (and
@@ -642,7 +743,12 @@ class TpuConsensusEngine(Generic[Scope]):
                 pid = int.from_bytes(digest[:4], "little") ^ int.from_bytes(
                     digest[4:8], "little"
                 )
-                if pid and (scope, pid) not in self._index and pid not in taken_set:
+                if (
+                    pid
+                    and (scope, pid) not in self._index
+                    and not self._tier_has(scope, pid)
+                    and pid not in taken_set
+                ):
                     proposal.proposal_id = pid
                     return
                 salt += 1
@@ -650,6 +756,7 @@ class TpuConsensusEngine(Generic[Scope]):
         collisions = regenerate_until_unique(
             proposal,
             lambda pid: (scope, pid) in self._index
+            or self._tier_has(scope, pid)
             or (taken is not None and pid in taken),
         )
         if collisions:
@@ -735,7 +842,12 @@ class TpuConsensusEngine(Generic[Scope]):
         cols = _CreationCols()
         batched: list[int] = []
         for idx, (scope, requests) in enumerate(items):
-            existing = len(self._scopes.get(scope, []))
+            # Demoted sessions still count against the per-scope cap (the
+            # reference trims on TOTAL population; a tier'd engine must
+            # evict at the same points an untier'd one would).
+            existing = len(self._scopes.get(scope, [])) + len(
+                self._tier.get(scope, ())
+            )
             if existing + len(requests) > self._max_sessions_per_scope:
                 fallbacks.append(idx)
             else:
@@ -748,7 +860,7 @@ class TpuConsensusEngine(Generic[Scope]):
         if not self._multihost and batched:
             total = sum(len(items[i][1]) for i in batched)
             if total:
-                parts = [self._pid_table(items[i][0])[0] for i in batched]
+                parts = [self._taken_pids(items[i][0]) for i in batched]
                 all_ids = self._draw_unique_pids(np.concatenate(parts), total)
                 off = 0
                 for i in batched:
@@ -808,7 +920,7 @@ class TpuConsensusEngine(Generic[Scope]):
             batch_ids = None
         else:
             batch_ids = self._draw_unique_pids(
-                self._pid_table(scope)[0], len(requests)
+                self._taken_pids(scope), len(requests)
             )
         # Config resolution is identical for requests sharing (expiration,
         # liveness) when no per-proposal override exists — memoize per batch.
@@ -979,7 +1091,11 @@ class TpuConsensusEngine(Generic[Scope]):
         same precedence create_proposal gives its explicit override — WAL
         replay uses this to preserve a logged override across recovery.
         """
-        if (scope, proposal.proposal_id) in self._index:
+        if (scope, proposal.proposal_id) in self._index or self._tier_has(
+            scope, proposal.proposal_id
+        ):
+            # Demoted sessions exist; the no-redelivery contract rejects
+            # without paging them in.
             raise ProposalAlreadyExist()
         wall0 = time.time()
         config = self._resolve_config(scope, config, proposal)
@@ -1105,6 +1221,7 @@ class TpuConsensusEngine(Generic[Scope]):
         # any signature work — exact scalar error precedence preserved.
         skip = [
             (scope, proposal.proposal_id) in self._index
+            or self._tier_has(scope, proposal.proposal_id)
             or now >= proposal.expiration_timestamp
             for scope, proposal in items
         ]
@@ -1172,7 +1289,11 @@ class TpuConsensusEngine(Generic[Scope]):
             verdicts, vote_hashes = pending_verify.collect()
 
         for i, (scope, proposal) in enumerate(items):
-            if (scope, proposal.proposal_id) in self._index:
+            if (scope, proposal.proposal_id) in self._index or self._tier_has(
+                scope, proposal.proposal_id
+            ):
+                # Demoted sessions exist: this path's strict
+                # no-redelivery contract rejects without paging them in.
                 statuses[i] = int(StatusCode.PROPOSAL_ALREADY_EXIST)
                 continue
             if spans[i] is None:
@@ -1320,9 +1441,13 @@ class TpuConsensusEngine(Generic[Scope]):
             key = (scope, proposal.proposal_id)
             # A known pid — or a pid this run is about to register — must
             # see the state all earlier items produced: flush first.
-            if key in self._index or key in run_keys:
+            # Demoted sessions are known: a redelivery that strictly
+            # extends one pages it back in and applies the suffix.
+            if key in self._index or key in run_keys or self._tier_has(*key):
                 flush_run()
             slot = self._index.get(key)
+            if slot is None:
+                slot = self._tier_lookup_promote(*key)
             if slot is None:
                 run.append(k)
                 run_keys.add(key)
@@ -1647,6 +1772,10 @@ class TpuConsensusEngine(Generic[Scope]):
         )
         if host_session is not None:
             record.votes = host_session.votes  # shared dict: one source of truth
+        record.last_activity = now
+        seq = self._scope_seq.get(scope, 0)
+        self._scope_seq[scope] = seq + 1
+        record.seq = seq
         self._records[slot] = record
         self._index[(scope, record.proposal.proposal_id)] = slot
         self._scopes.setdefault(scope, []).append(slot)
@@ -1654,7 +1783,9 @@ class TpuConsensusEngine(Generic[Scope]):
         self._timelines.created(
             slot, scope, record.proposal.proposal_id, now, time.monotonic()
         )
-        self._m_proposals.inc()
+        if not self._promoting:
+            # Paging a demoted session back in is not a fresh proposal.
+            self._m_proposals.inc()
         return record
 
     def _register_session(
@@ -1883,12 +2014,15 @@ class TpuConsensusEngine(Generic[Scope]):
         or a cacheless scalar call).
 
         Safe to call for batch k+1 BEFORE batch k applies — that is the
-        double-buffered pipeline — because ingest_votes never registers,
-        evicts, or unregisters sessions: the ``_index`` resolution and
-        everything the prepass reads are invariant across vote applies.
-        (Interleaving proposal registration/eviction between begin and
-        apply is NOT supported; ingest_votes_pipelined only chains vote
-        batches, so the invariant holds by construction.)"""
+        double-buffered pipeline — because ingest_votes never evicts or
+        unregisters sessions: everything the prepass RESOLVED stays
+        resolved across vote applies. A batch may page a demoted session
+        back in (tier promotion), but that only ADDS index entries — a
+        row the prepass saw as session-less simply verifies inline at
+        apply time, exactly like the cacheless path. (Interleaving
+        proposal registration/eviction between begin and apply is NOT
+        supported; ingest_votes_pipelined only chains vote batches, so
+        the invariant holds by construction.)"""
         batch = len(items)
         if pre_validated or not (
             batch > 1 or (batch == 1 and self._verify_cache is not None)
@@ -2014,8 +2148,12 @@ class TpuConsensusEngine(Generic[Scope]):
         for i, (scope, vote) in enumerate(items):
             slot = self._index.get((scope, vote.proposal_id))
             if slot is None:
-                statuses[i] = int(StatusCode.SESSION_NOT_FOUND)
-                continue
+                # Late vote on a demoted session: demand-page it back in
+                # and apply exactly as if it had never left.
+                slot = self._tier_lookup_promote(scope, vote.proposal_id)
+                if slot is None:
+                    statuses[i] = int(StatusCode.SESSION_NOT_FOUND)
+                    continue
             record = self._records[slot]
             if (
                 self._multihost
@@ -2106,6 +2244,7 @@ class TpuConsensusEngine(Generic[Scope]):
                 statuses[i] = code
                 if code == int(StatusCode.OK):
                     host_accepted += 1
+                    record.last_activity = now
                     owner = vote.vote_owner
                     admit_counts[owner] = admit_counts.get(owner, 0) + 1
                     if record.config.consensus_timeout > admit_timeout:
@@ -2220,7 +2359,9 @@ class TpuConsensusEngine(Generic[Scope]):
                 )
                 last_ok[int(slots[j])] = j
         for slot in last_ok:
-            cfg_timeout = self._records[slot].config.consensus_timeout
+            record = self._records[slot]
+            record.last_activity = now
+            cfg_timeout = record.config.consensus_timeout
             if cfg_timeout > admit_timeout:
                 admit_timeout = cfg_timeout
             self._timelines.voted(slot, now, wall)
@@ -2435,6 +2576,8 @@ class TpuConsensusEngine(Generic[Scope]):
         if done:
             return statuses
         found, slots = self._pid_lookup(scope).lookup(proposal_ids)
+        if self._promote_columnar_misses([scope], None, proposal_ids, found):
+            found, slots = self._pid_lookup(scope).lookup(proposal_ids)
         return self._columnar_finish(
             slots, found, voter_gids, values, now, max_depth, statuses,
             wire_norm,
@@ -2621,7 +2764,21 @@ class TpuConsensusEngine(Generic[Scope]):
         self, scopes: list, scope_idx: np.ndarray, proposal_ids: np.ndarray
     ) -> "tuple[np.ndarray, np.ndarray]":
         """Mixed-scope proposal-id resolution shared by the columnar entry
-        points: (found bool[B], slots int64[B])."""
+        points: (found bool[B], slots int64[B]). Rows that miss the live
+        index but hit the demoted tier page their sessions back in and
+        re-resolve — columnar late votes see an untier'd engine."""
+        found, slots = self._resolve_slots_multi_once(
+            scopes, scope_idx, proposal_ids
+        )
+        if self._promote_columnar_misses(scopes, scope_idx, proposal_ids, found):
+            found, slots = self._resolve_slots_multi_once(
+                scopes, scope_idx, proposal_ids
+            )
+        return found, slots
+
+    def _resolve_slots_multi_once(
+        self, scopes: list, scope_idx: np.ndarray, proposal_ids: np.ndarray
+    ) -> "tuple[np.ndarray, np.ndarray]":
         batch = len(proposal_ids)
         found = np.zeros(batch, bool)
         slots = np.zeros(batch, np.int64)
@@ -3240,6 +3397,7 @@ class TpuConsensusEngine(Generic[Scope]):
             )
             statuses[i] = code
             if code == int(StatusCode.OK):
+                record.last_activity = now
                 self._timelines.voted(slot, now, wall)
                 self._m_votes_accepted.inc()
             self.tracer.count(
@@ -3539,7 +3697,9 @@ class TpuConsensusEngine(Generic[Scope]):
                 cnt = np.bincount(grp_sorted[ok_m], minlength=len(uniq))
                 for g in np.nonzero(cnt)[0].tolist():
                     slot = int(uniq[g])
-                    self._records[slot].bump_round(int(cnt[g]))
+                    record = self._records[slot]
+                    record.bump_round(int(cnt[g]))
+                    record.last_activity = now
                     self._timelines.voted(slot, now, wall)
 
         # Events: one ConsensusReached per deciding transition plus one per
@@ -3721,7 +3881,11 @@ class TpuConsensusEngine(Generic[Scope]):
         when undecidable."""
         slot = self._index.get((scope, proposal_id))
         if slot is None:
-            raise SessionNotFound()
+            # A demoted (idle) session can still be timed out by the
+            # embedder: page it back in and fire as if it never left.
+            slot = self._tier_lookup_promote(scope, proposal_id)
+            if slot is None:
+                raise SessionNotFound()
         # Timeout calls carry the embedder's clock even when vote traffic
         # has stopped — exactly when the liveness watchdog needs a
         # current tick to measure silence against.
@@ -3729,6 +3893,10 @@ class TpuConsensusEngine(Generic[Scope]):
         record = self._records[slot]
         owned = self._owns_slot(slot)
         was_active = self._state_code(record) == STATE_ACTIVE
+        if was_active:
+            # A fired timeout is the session's deciding activity: the GC
+            # TTL for decided sessions measures from here.
+            record.last_activity = now
         if record.session is not None:
             new_state = self._host_timeout(record, now)
         else:
@@ -3780,7 +3948,9 @@ class TpuConsensusEngine(Generic[Scope]):
             )
         raise InsufficientVotesAtTimeout()
 
-    def sweep_timeouts(self, now: int) -> list[tuple[Scope, int, bool | None]]:
+    def sweep_timeouts(
+        self, now: int, _gc_sink: "list | None" = None
+    ) -> list[tuple[Scope, int, bool | None]]:
         """Engine-level convenience absent from the reference (its embedder
         schedules per-proposal timers): fire the timeout decision for every
         still-undecided session whose expiration has passed, in one device
@@ -3796,6 +3966,9 @@ class TpuConsensusEngine(Generic[Scope]):
         (zero DCN on the ingest path)."""
         if self._multihost:
             self._pool.sync_states()
+        # Expired idle sessions sleeping in the demoted tier must fire
+        # their timeouts exactly like live ones: page them in first.
+        self._promote_expired_tier(now)
         expired: list[int] = []
         host_expired: list[int] = []
         for slot, record in self._records.items():
@@ -3833,6 +4006,10 @@ class TpuConsensusEngine(Generic[Scope]):
         # session once, not once per process.
         self._m_timeouts.inc(sum(1 for _, _, owned in swept if owned))
         for slot, new_state, owned in swept:
+            # The fired timeout is the session's deciding activity (GC
+            # TTLs measure from it); ownership-independent like the
+            # timeline stamp.
+            self._records[slot].last_activity = now
             outcome = _OUTCOME_OF_STATE.get(new_state)
             if outcome is not None:
                 self._timelines.decided(
@@ -3864,6 +4041,10 @@ class TpuConsensusEngine(Generic[Scope]):
                     ConsensusFailedEvent(proposal_id=pid, timestamp=now),
                 )
                 out.append((record.scope, pid, None))
+        # The engine-wide tier cadence rides the sweep the embedder
+        # already drives: demote idle sessions, GC decided ones past
+        # their per-scope TTLs (no-op without ScopeConfig tier knobs).
+        self.lifecycle_sweep(now, _gc_sink=_gc_sink)
         return out
 
     # ── Queries (reference: src/storage.rs:112-180 derived helpers) ────
@@ -3937,12 +4118,33 @@ class TpuConsensusEngine(Generic[Scope]):
             raise ConsensusFailed()
         return None
 
+    def _tier_sessions_where(self, scope: Scope, want_state: "int | None"):
+        """Decode a scope's demoted sessions (``want_state`` filters on
+        the stored snapshot state code; None = all) WITHOUT promoting —
+        enumeration reads pass through the tier, only point reads and
+        mutations page sessions back in."""
+        entries = self._tier.get(scope)
+        if not entries:
+            return
+        from ..sync.snapshot import decode_session_item
+
+        for entry in entries.values():
+            if want_state is not None and entry.state != want_state:
+                continue
+            _, session = decode_session_item(entry.item)
+            yield entry, session
+
     def get_active_proposals(self, scope: Scope) -> list[Proposal]:
-        return [
+        out = [
             self._materialized_proposal(r)
             for r in self._scope_records(scope)
             if self._state_code(r) == STATE_ACTIVE
         ]
+        out.extend(
+            session.proposal
+            for _, session in self._tier_sessions_where(scope, 0)
+        )
+        return out
 
     def get_reached_proposals(self, scope: Scope) -> list[tuple[Proposal, bool]]:
         out = []
@@ -3950,10 +4152,16 @@ class TpuConsensusEngine(Generic[Scope]):
             state = self._state_code(r)
             if state in (STATE_REACHED_YES, STATE_REACHED_NO):
                 out.append((self._materialized_proposal(r), state == STATE_REACHED_YES))
+        out.extend(
+            (session.proposal, bool(entry.result))
+            for entry, session in self._tier_sessions_where(scope, 1)
+        )
         return out
 
     def get_scope_stats(self, scope: Scope) -> ConsensusStats:
-        """reference: src/service_stats.rs:32-59 (zeros for unknown scope)."""
+        """reference: src/service_stats.rs:32-59 (zeros for unknown scope).
+        Demoted sessions count from their stored state metadata — no
+        decode, no promotion."""
         stats = ConsensusStats()
         for r in self._scope_records(scope):
             stats.total_sessions += 1
@@ -3964,6 +4172,16 @@ class TpuConsensusEngine(Generic[Scope]):
                 stats.failed_sessions += 1
             else:
                 stats.consensus_reached += 1
+        entries = self._tier.get(scope)
+        if entries:
+            for entry in entries.values():
+                stats.total_sessions += 1
+                if entry.state == 0:
+                    stats.active_sessions += 1
+                elif entry.state == 2:
+                    stats.failed_sessions += 1
+                else:
+                    stats.consensus_reached += 1
         return stats
 
     def proposal_timeline(self, scope: Scope, proposal_id: int) -> dict | None:
@@ -4128,6 +4346,11 @@ class TpuConsensusEngine(Generic[Scope]):
         planners (parallel.fleet's per-shard breakdown)."""
         with self._lock:
             slots = list(self._records)
+            tier_sessions = self._tier_count
+            tier_bytes = self._tier_bytes
+            demotions = self._tier_demotions
+            promotions = self._tier_promotions
+            gc = self._tier_gc
         device_used = sum(1 for s in slots if s >= 0)
         return {
             "live_sessions": len(slots),
@@ -4135,15 +4358,27 @@ class TpuConsensusEngine(Generic[Scope]):
             "host_spilled": len(slots) - device_used,
             "capacity": self._pool.capacity,
             "voter_capacity": self._pool.voter_capacity,
+            # Demoted tier: population + serialized footprint, and this
+            # engine's lifetime demote/promote/GC traffic.
+            "tier_sessions": tier_sessions,
+            "tier_bytes": tier_bytes,
+            "tier_demotions_total": demotions,
+            "tier_promotions_total": promotions,
+            "tier_gc_total": gc,
         }
 
     def session_keys(self) -> "list[tuple[Scope, int]]":
         """Every tracked ``(scope, proposal_id)`` in one consistent read —
         the enumeration a gossip node needs to bootstrap its anti-entropy
         bookkeeping after installing state it did not ingest itself
-        (catch-up, storage load)."""
+        (catch-up, storage load). Demoted sessions are tracked sessions:
+        their keys enumerate too (anti-entropy watermarks must cover
+        them, or a peer would re-push state this engine already holds)."""
         with self._lock:
-            return list(self._index.keys())
+            keys = list(self._index.keys())
+            for scope, entries in self._tier.items():
+                keys.extend((scope, pid) for pid in entries)
+            return keys
 
     def export_session(self, scope: Scope, proposal_id: int) -> ConsensusSession:
         """Materialise a scalar ConsensusSession from the pooled state —
@@ -4153,7 +4388,15 @@ class TpuConsensusEngine(Generic[Scope]):
         (lane -> owner via the gid registry); rows whose verbatim wire bytes
         were retained export as real signed votes instead of tallies, so the
         re-gossip capability survives a save/load round-trip."""
-        record = self._get_record(scope, proposal_id)
+        return self._export_record(self._get_record(scope, proposal_id))
+
+    def _export_record(
+        self, record: SessionRecord[Scope], row: "dict | None" = None
+    ) -> ConsensusSession:
+        """Body of :meth:`export_session` over an already-resolved record.
+        ``row`` optionally injects the slot's device row (vote_mask /
+        vote_val) pre-fetched by a batched ``pool.read_slots`` gather — the
+        demotion path exports many sessions per device round-trip."""
         retained_votes = [
             vote for _, votes in self._decoded_retained(record) for vote in votes
         ]
@@ -4171,7 +4414,8 @@ class TpuConsensusEngine(Generic[Scope]):
             return session
         votes = {k: v.clone() for k, v in record.votes.items()}
         tallies: dict[bytes, bool] = {}
-        row = self._pool.read_slot(record.slot)
+        if row is None:
+            row = self._pool.read_slot(record.slot)
         lane_owners = self._pool.lane_owners(record.slot)
         for lane in np.nonzero(row["vote_mask"])[0]:
             owner = lane_owners.get(int(lane))
@@ -4198,7 +4442,13 @@ class TpuConsensusEngine(Generic[Scope]):
     def save_to_storage(self, storage) -> int:
         """Persist every tracked session (and scope configs) into a
         ConsensusStorage backend — the reference's durability abstraction
-        (src/storage.rs:18-22). Returns the number of sessions written."""
+        (src/storage.rs:18-22). Returns the number of sessions written.
+
+        Demoted sessions are persisted too, decoded straight from their
+        canonical tier bytes — snapshot builds and fingerprints therefore
+        carry the identical session items whether a session is live or
+        demoted (the codec round-trips byte-identically; the tier/untier'd
+        fingerprint-equality property pins it)."""
         count = 0
         for scope, slots in self._scopes.items():
             for slot in slots:
@@ -4206,6 +4456,10 @@ class TpuConsensusEngine(Generic[Scope]):
                 storage.save_session(
                     scope, self.export_session(scope, record.proposal.proposal_id)
                 )
+                count += 1
+        for scope in self._tier:
+            for _, session in self._tier_sessions_where(scope, None):
+                storage.save_session(scope, session)
                 count += 1
         for scope, config in self._scope_configs.items():
             storage.set_scope_config(scope, config.clone())
@@ -4225,7 +4479,9 @@ class TpuConsensusEngine(Generic[Scope]):
                 self._scope_configs[scope] = config.clone()
             sessions = storage.list_scope_sessions(scope) or []
             for session in sorted(sessions, key=lambda s: s.created_at):
-                if (scope, session.proposal.proposal_id) in self._index:
+                if (scope, session.proposal.proposal_id) in self._index or (
+                    self._tier_has(scope, session.proposal.proposal_id)
+                ):
                     continue  # already tracked (idempotent restore)
                 self._register_session(scope, session.clone(), session.created_at)
                 count += 1
@@ -4253,7 +4509,450 @@ class TpuConsensusEngine(Generic[Scope]):
             all_slots.extend(s for s in slots if s >= 0)
             self._scope_configs.pop(scope, None)
             self._drop_pid_cache(scope)
+            # The demoted tier drops with the scope, like live sessions.
+            entries = self._tier.pop(scope, None)
+            if entries:
+                self._tier_count -= len(entries)
+                self._tier_bytes -= sum(len(e.item) for e in entries.values())
+                for pid, entry in entries.items():
+                    if entry.state == 0:
+                        self._tier_active.pop((scope, pid), None)
+                self._tier_pid_arrays.pop(scope, None)
+            self._pinned_scopes.discard(scope)
+            self._scope_seq.pop(scope, None)
         self._pool.release(all_slots)
+
+    # ── Tiered session lifecycle (demote / demand-page / GC) ───────────
+    #
+    # The ARIES / Raft log-compaction frame (PAPERS.md): the WAL already
+    # makes any in-memory representation a rebuildable cache, so a
+    # decided/idle session can drop its device slot and host record and
+    # live on as its canonical serialized bytes (the PR-8 snapshot item
+    # format — the exact signed wire, so promotion re-registers without
+    # re-signing and fingerprints hash the same items either way). Every
+    # public surface reads through the tier: point reads and mutations
+    # page the session back in, enumerations/stats/save_to_storage read
+    # the tier without promoting — callers observe an untier'd engine.
+
+    def _tier_has(self, scope: Scope, proposal_id: int) -> bool:
+        entries = self._tier.get(scope)
+        return entries is not None and proposal_id in entries
+
+    def _tier_lookup_promote(self, scope: Scope, proposal_id: int) -> "int | None":
+        """Slot of a demoted session after paging it back in; None when
+        the session is not in the tier (the caller's miss is real)."""
+        entries = self._tier.get(scope)
+        if entries is None or proposal_id not in entries:
+            return None
+        return self._promote_key(scope, proposal_id)
+
+    def demote_session(self, scope: Scope, proposal_id: int) -> bool:
+        """Move one session out of its device slot / host record into the
+        compact serialized tier. Idempotent: False when already demoted.
+        Raises SessionNotFound for unknown sessions. The session stays
+        fully addressable — any read or late vote transparently promotes
+        it back (see the section comment)."""
+        if self._multihost:
+            raise RuntimeError(
+                "session tiering is not supported on multi-host pools"
+            )
+        if self._tier_has(scope, proposal_id):
+            return False
+        slot = self._index.get((scope, proposal_id))
+        if slot is None:
+            raise SessionNotFound()
+        self._demote_records(scope, [slot])
+        return True
+
+    # Pool lifecycle code -> (snapshot state code, result).
+    _POOL_TO_SNAP = {
+        STATE_ACTIVE: (0, False),
+        STATE_REACHED_YES: (1, True),
+        STATE_REACHED_NO: (1, False),
+        STATE_FAILED: (2, False),
+    }
+
+    def _demote_records(self, scope: Scope, slots: "list[int]") -> int:
+        """Batched demotion of live slots belonging to one scope: ONE
+        device gather for every pooled slot's tally row, one pool release
+        dispatch, one pid-cache drop. Plain pooled sessions (the churn
+        steady state) encode field-direct — per-call memoized scope/config
+        bytes, tallies straight off the gathered row, the live proposal's
+        wire bytes — with no intermediate ConsensusSession; byte-identity
+        with the session-object codec is pinned by the tier fingerprint
+        property suite."""
+        from ..sync.snapshot import (
+            _STATE_CODE,
+            encode_session_fields,
+            encode_session_item,
+        )
+        from ..wal import format as F
+
+        records = [self._records[s] for s in slots]
+        rows: dict[int, dict] = {}
+        pool_states: dict[int, int] = {}
+        pooled = [r for r in records if r.session is None]
+        if pooled:
+            pooled_slots = [r.slot for r in pooled]
+            batch = self._pool.read_slots(pooled_slots)
+            states = self._pool.states_of(pooled_slots).tolist()
+            masks = batch["vote_mask"]
+            vals = batch["vote_val"]
+            for k, r in enumerate(pooled):
+                rows[r.slot] = {
+                    "vote_mask": masks[k],
+                    "vote_val": vals[k],
+                }
+                pool_states[r.slot] = states[k]
+        entries = self._tier.setdefault(scope, {})
+        scope_bytes = F.encode_scope(scope)
+        cfg_bytes: dict[int, bytes] = {}  # id(config) -> canonical encode
+        # Vote-free proposals sharing every field but the id (the churn
+        # steady state: whole waves minted from one request shape) encode
+        # via ONE cached (head, tail) split per shape + a per-item id
+        # varint — Proposal.encode's nine-field walk was the single
+        # biggest demotion cost.
+        split_cache: dict[tuple, tuple[bytes, bytes]] = {}
+        from ..wire import _U32_MASK as _PIDM
+        from ..wire import _encode_uint_field
+        for record in records:
+            pid = record.proposal.proposal_id
+            if record.session is None and not record.retained_wire:
+                # Fast path: encode from the record's parts directly.
+                state, result = self._POOL_TO_SNAP[pool_states[record.slot]]
+                row = rows[record.slot]
+                lane_owners = self._pool.lane_owners(record.slot)
+                votes = record.votes
+                tallies: dict[bytes, bool] = {}
+                # Voter lanes are few (<= voter_capacity): a plain list
+                # walk beats np.nonzero on tiny rows.
+                val_row = row["vote_val"].tolist()
+                for lane, on in enumerate(row["vote_mask"].tolist()):
+                    if not on:
+                        continue
+                    owner = lane_owners.get(lane)
+                    if owner is None or owner in votes:
+                        continue
+                    tallies[owner] = bool(val_row[lane])
+                config_bytes = cfg_bytes.get(id(record.config))
+                if config_bytes is None:
+                    config_bytes = F.encode_consensus_config(record.config)
+                    cfg_bytes[id(record.config)] = config_bytes
+                p = record.proposal
+                if not p.votes:
+                    shape = (
+                        p.name,
+                        p.payload,
+                        p.proposal_owner,
+                        p.expected_voters_count,
+                        p.round,
+                        p.timestamp,
+                        p.expiration_timestamp,
+                        p.liveness_criteria_yes,
+                    )
+                    parts = split_cache.get(shape)
+                    if parts is None:
+                        parts = p.encode_split()
+                        split_cache[shape] = parts
+                    buf = bytearray(parts[0])
+                    _encode_uint_field(buf, 12, p.proposal_id & _PIDM)
+                    buf += parts[1]
+                    proposal_wire = bytes(buf)
+                else:
+                    proposal_wire = p.encode()
+                item = encode_session_fields(
+                    scope_bytes,
+                    state,
+                    result,
+                    record.created_at,
+                    config_bytes,
+                    tallies,
+                    proposal_wire,
+                )
+            else:
+                session = self._export_record(record, row=rows.get(record.slot))
+                item = encode_session_item(scope, session)
+                state = _STATE_CODE[session.state.kind]
+                result = bool(session.state.result)
+            entries[pid] = _TierEntry(
+                item,
+                state,
+                result,
+                record.created_at,
+                record.seq,
+                record.proposal.expiration_timestamp,
+                record.last_activity,
+            )
+            self._tier_count += 1
+            self._tier_bytes += len(item)
+            if state == 0:
+                # Idle-but-active: the timeout sweep must still find it.
+                self._tier_active[(scope, pid)] = (
+                    record.proposal.expiration_timestamp
+                )
+        self._drop_live_slots(scope, slots)
+        self._tier_pid_arrays.pop(scope, None)
+        n = len(records)
+        self._tier_demotions += n
+        self._m_tier_demotions.inc(n)
+        self.tracer.count("engine.tier_demotions", n)
+        return n
+
+    def _promote_key(self, scope: Scope, proposal_id: int) -> "int | None":
+        """Page one demoted session back in: decode the stored item bytes
+        and re-register on the live substrate (device slot, or the
+        host-spilled negative-slot path for sessions the pool geometry
+        cannot hold — tally-carrying ones included). The session keeps its
+        original created_at / LRU rank / idle clock, so demote→promote is
+        invisible to eviction and TTL policies."""
+        from ..sync.snapshot import decode_session_item
+
+        entries = self._tier[scope]
+        entry = entries.pop(proposal_id)
+        if not entries:
+            del self._tier[scope]
+        self._tier_count -= 1
+        self._tier_bytes -= len(entry.item)
+        if entry.state == 0:
+            self._tier_active.pop((scope, proposal_id), None)
+        self._tier_pid_arrays.pop(scope, None)
+        _, session = decode_session_item(entry.item)
+        self._promoting = True
+        try:
+            self._register_session(scope, session, entry.created_at)
+        finally:
+            self._promoting = False
+        self._tier_promotions += 1
+        self._m_tier_promotions.inc()
+        self.tracer.count("engine.tier_promotions")
+        slot = self._index.get((scope, proposal_id))
+        if slot is None:
+            return None  # lost the per-scope LRU ranking outright
+        record = self._records[slot]
+        record.last_activity = entry.last_activity
+        record.seq = entry.seq
+        return slot
+
+    def _promote_expired_tier(self, now: int) -> None:
+        """Page back every ACTIVE demoted session whose expiry has passed
+        so the timeout sweep fires it exactly as if it had never left.
+        Scans only the (small) active-tier side map, never the decided
+        mass."""
+        if not self._tier_active:
+            return
+        due = [
+            key for key, expiry in self._tier_active.items() if expiry <= now
+        ]
+        for scope, pid in due:
+            if self._tier_has(scope, pid):
+                self._promote_key(scope, pid)
+
+    def _promote_columnar_misses(
+        self, scopes: list, scope_idx, proposal_ids: np.ndarray,
+        found: np.ndarray,
+    ) -> bool:
+        """Demand-page demoted sessions hit by a columnar batch: check the
+        unresolved rows against the tier and promote any hits. Returns
+        True when a promotion happened (the caller re-resolves — the pid
+        caches were rebuilt by registration). Free when the tier is empty;
+        otherwise per-MISS Python only, never per-row."""
+        if not self._tier:
+            return False
+        miss = np.nonzero(~found)[0]
+        if miss.size == 0:
+            return False
+        promoted = False
+        seen: set = set()
+        idx_list = None if scope_idx is None else scope_idx
+        for i in miss.tolist():
+            scope = scopes[0] if idx_list is None else scopes[int(idx_list[i])]
+            pid = int(proposal_ids[i])
+            key = (scope, pid)
+            if key in seen:
+                continue
+            seen.add(key)
+            entries = self._tier.get(scope)
+            if entries is not None and pid in entries:
+                self._promote_key(scope, pid)
+                promoted = True
+        return promoted
+
+    def _drop_live_slots(self, scope: Scope, slots: "list[int]") -> None:
+        """Shared live-slot teardown (cap eviction / TTL GC / demotion):
+        untrack records, forget timelines, filter the scope list, release
+        pool slots, drop the pid caches — ONE copy of the sequence, so a
+        future bookkeeping field cannot be dropped from just one site."""
+        gone = set(slots)
+        for slot in slots:
+            record = self._records.pop(slot)
+            del self._index[(scope, record.proposal.proposal_id)]
+            self._timelines.forget(slot)
+        live = self._scopes.get(scope)
+        if live is not None:
+            self._scopes[scope] = [s for s in live if s not in gone]
+        release = [s for s in slots if s >= 0]
+        if release:
+            self._pool.release(release)
+        self._drop_pid_cache(scope)
+
+    def _gc_live(self, scope: Scope, slots: "list[int]") -> int:
+        """Garbage-collect decided live sessions past their per-scope
+        ``evict_decided_after`` TTL: dropped outright (session, slot,
+        timeline), exactly like a per-scope-cap eviction but policy-driven."""
+        self._drop_live_slots(scope, slots)
+        n = len(slots)
+        self._tier_gc += n
+        self._m_tier_gc.inc(n)
+        self.tracer.count("engine.tier_gc", n)
+        return n
+
+    def _gc_tier(self, scope: Scope, pids: "list[int]") -> int:
+        """Garbage-collect demoted decided sessions past the TTL."""
+        entries = self._tier[scope]
+        for pid in pids:
+            entry = entries.pop(pid)
+            self._tier_count -= 1
+            self._tier_bytes -= len(entry.item)
+        if not entries:
+            del self._tier[scope]
+        self._tier_pid_arrays.pop(scope, None)
+        n = len(pids)
+        self._tier_gc += n
+        self._m_tier_gc.inc(n)
+        self.tracer.count("engine.tier_gc", n)
+        return n
+
+    def lifecycle_sweep(self, now: int, _gc_sink: "list | None" = None) -> dict:
+        """Apply every scope's tier TTL policies (ScopeConfig
+        ``demote_after`` / ``evict_decided_after``) at the embedder's
+        logical clock: GC decided/failed sessions past the eviction TTL
+        (live or already demoted), then demote sessions idle past the
+        demotion TTL. Runs automatically at the end of every
+        :meth:`sweep_timeouts` (the engine-wide cadence embedders already
+        drive); callable standalone for a custom cadence. Pinned scopes
+        (:meth:`pin_scope` — fleet migration freeze) and scopes without
+        TTL knobs are untouched. Returns ``{demoted, gc_live, gc_tier}``.
+
+        ``_gc_sink`` (private) collects the GC'd ``(scope, pid)`` keys —
+        a DurableEngine logs them as the KIND_GC outcome record. During
+        WAL replay the whole sweep is a no-op (set_replay_mode): TTL
+        decisions ride idle clocks a snapshot restore does not carry, so
+        recovery applies the live run's logged outcome instead of
+        re-deriving the policy. A freshly recovered engine's sessions
+        restart their idle clocks from created_at (or their replayed
+        activity) — demotion may then run early, which is invisible, and
+        decided-session GC may collect somewhat earlier than the
+        pre-crash clock would have, which is the documented
+        retention-policy semantics across restarts."""
+        out = {"demoted": 0, "gc_live": 0, "gc_tier": 0}
+        if self._multihost or not self._lifecycle_live:
+            return out  # replicated control plane / WAL replay
+        for scope, config in list(self._scope_configs.items()):
+            demote_after = config.demote_after
+            evict_after = config.evict_decided_after
+            if (demote_after is None and evict_after is None) or (
+                scope in self._pinned_scopes
+            ):
+                continue
+            records = self._records
+            if evict_after is not None:
+                # Cheap TTL filter first (one attribute compare per live
+                # record); the state check — a batched host-mirror gather
+                # for pooled records — runs on the survivors only.
+                cutoff = now - evict_after
+                cand = [
+                    s
+                    for s in self._scopes.get(scope, [])
+                    if records[s].last_activity <= cutoff
+                ]
+                gc_slots = []
+                if cand:
+                    pooled = [s for s in cand if records[s].session is None]
+                    pooled_state = (
+                        dict(zip(pooled, self._pool.states_of(pooled).tolist()))
+                        if pooled
+                        else {}
+                    )
+                    for s in cand:
+                        state = pooled_state.get(s)
+                        if state is None:
+                            state = state_code_of(records[s].session.state)
+                        if state != STATE_ACTIVE:
+                            gc_slots.append(s)
+                if gc_slots:
+                    if _gc_sink is not None:
+                        _gc_sink.extend(
+                            (scope, records[s].proposal.proposal_id)
+                            for s in gc_slots
+                        )
+                    out["gc_live"] += self._gc_live(scope, gc_slots)
+                entries = self._tier.get(scope)
+                if entries:
+                    dead = [
+                        pid
+                        for pid, e in entries.items()
+                        if e.state != 0 and e.last_activity <= cutoff
+                    ]
+                    if dead:
+                        if _gc_sink is not None:
+                            _gc_sink.extend((scope, pid) for pid in dead)
+                        out["gc_tier"] += self._gc_tier(scope, dead)
+            if demote_after is not None:
+                cutoff = now - demote_after
+                idle = [
+                    s
+                    for s in self._scopes.get(scope, [])
+                    if records[s].last_activity <= cutoff
+                ]
+                if idle:
+                    out["demoted"] += self._demote_records(scope, idle)
+        if out["demoted"] or out["gc_live"] or out["gc_tier"]:
+            flight_recorder.record("engine.lifecycle_sweep", **out)
+        return out
+
+    def gc_sessions(self, keys: "list[tuple[Scope, int]]") -> int:
+        """Apply an exact GC outcome: drop each ``(scope, pid)`` — live
+        or demoted — counting it as tier GC. Unknown keys are skipped
+        (idempotent). This is the replay entry point for KIND_GC records
+        (the live sweep's logged outcome), usable by embedders as an
+        explicit per-session retirement too."""
+        applied = 0
+        by_scope_live: dict[Scope, list[int]] = {}
+        by_scope_tier: dict[Scope, list[int]] = {}
+        for scope, pid in keys:
+            slot = self._index.get((scope, pid))
+            if slot is not None:
+                by_scope_live.setdefault(scope, []).append(slot)
+            elif self._tier_has(scope, pid):
+                by_scope_tier.setdefault(scope, []).append(pid)
+        for scope, slots in by_scope_live.items():
+            applied += self._gc_live(scope, slots)
+        for scope, pids in by_scope_tier.items():
+            applied += self._gc_tier(scope, pids)
+        return applied
+
+    def pin_scope(self, scope: Scope) -> None:
+        """Exclude a scope from the lifecycle sweep's demote/GC policies
+        (idempotent). The fleet/federation routers pin a shard's scopes
+        for the duration of a live migration so nothing pages mid-flip."""
+        self._pinned_scopes.add(scope)
+
+    def unpin_scope(self, scope: Scope) -> None:
+        self._pinned_scopes.discard(scope)
+
+    def _taken_pids(self, scope: Scope) -> np.ndarray:
+        """Every proposal id currently claimed in ``scope`` — live AND
+        demoted — for batch id draws (a fresh id colliding with a demoted
+        session would alias two sessions onto one key at promotion)."""
+        live = self._pid_table(scope)[0]
+        entries = self._tier.get(scope)
+        if not entries:
+            return live
+        tier = self._tier_pid_arrays.get(scope)
+        if tier is None:
+            tier = np.fromiter(entries.keys(), np.int64, len(entries))
+            self._tier_pid_arrays[scope] = tier
+        return np.concatenate([live, tier])
 
     # ── Scope config (reference: src/service.rs:375-484) ───────────────
 
@@ -4291,6 +4990,8 @@ class TpuConsensusEngine(Generic[Scope]):
         existing.default_timeout = config.default_timeout
         existing.default_liveness_criteria_yes = config.default_liveness_criteria_yes
         existing.max_rounds_override = config.max_rounds_override
+        existing.demote_after = config.demote_after
+        existing.evict_decided_after = config.evict_decided_after
         existing.validate()
         self._scope_configs[scope] = existing
 
@@ -4342,7 +5043,12 @@ class TpuConsensusEngine(Generic[Scope]):
     def _get_record(self, scope: Scope, proposal_id: int) -> SessionRecord[Scope]:
         slot = self._index.get((scope, proposal_id))
         if slot is None:
-            raise SessionNotFound()
+            # Demand-page: a point read on a demoted session promotes it
+            # back transparently (get_result / EXPLAIN / export / gossip
+            # reconstruction all land here).
+            slot = self._tier_lookup_promote(scope, proposal_id)
+            if slot is None:
+                raise SessionNotFound()
         return self._records[slot]
 
     def _scope_records(self, scope: Scope) -> list[SessionRecord[Scope]]:
@@ -4355,26 +5061,50 @@ class TpuConsensusEngine(Generic[Scope]):
         ``max`` of incumbents+newcomer (ties favor incumbents, matching the
         insert-then-trim stable sort). Evicts surplus incumbents; returns
         True when the newcomer itself loses the ranking and must not be
-        tracked."""
+        tracked.
+
+        Demoted sessions are incumbents too: they count against the cap
+        and evict on the same ranking (ordered by their per-scope ``seq``,
+        which reconstructs the original insertion order even after a
+        demote→promote round-trip re-appended a record), so a tiered
+        engine evicts exactly the sessions its untier'd twin would."""
         slots = self._scopes.get(scope, [])
-        if len(slots) + 1 <= self._max_sessions_per_scope:
+        tier_entries = self._tier.get(scope)
+        n_tier = len(tier_entries) if tier_entries else 0
+        if len(slots) + n_tier + 1 <= self._max_sessions_per_scope:
             return False
-        newcomer = object()  # appended last: loses created_at ties
-        ranked = sorted(
-            [*slots, newcomer],
-            key=lambda s: now if s is newcomer else self._records[s].created_at,
-            reverse=True,
-        )
-        keep = set(ranked[: self._max_sessions_per_scope])
-        evicted = [s for s in slots if s not in keep]
-        if evicted:
-            self._scopes[scope] = [s for s in slots if s in keep]
-            for slot in evicted:
-                record = self._records.pop(slot)
-                del self._index[(scope, record.proposal.proposal_id)]
-                self._timelines.forget(slot)
-            self._pool.release([s for s in evicted if s >= 0])
-            self._drop_pid_cache(scope)
+        # (created_at, seq, is_tier, key): seq-ascending reproduces the
+        # per-scope insertion order; the newcomer's infinite seq loses
+        # created_at ties to every incumbent (insert-then-trim order).
+        items = [
+            (self._records[s].created_at, self._records[s].seq, False, s)
+            for s in slots
+        ]
+        if tier_entries:
+            items.extend(
+                (e.created_at, e.seq, True, pid)
+                for pid, e in tier_entries.items()
+            )
+        newcomer = (now, float("inf"), False, None)
+        items.append(newcomer)
+        items.sort(key=lambda t: t[1])
+        items.sort(key=lambda t: t[0], reverse=True)
+        keep = items[: self._max_sessions_per_scope]
+        evicted = items[self._max_sessions_per_scope :]
+        evicted_slots = [k for _, _, is_tier, k in evicted if not is_tier and k is not None]
+        evicted_pids = [k for _, _, is_tier, k in evicted if is_tier]
+        if evicted_slots:
+            self._drop_live_slots(scope, evicted_slots)
+        if evicted_pids:
+            for pid in evicted_pids:
+                entry = tier_entries.pop(pid)
+                self._tier_count -= 1
+                self._tier_bytes -= len(entry.item)
+                if entry.state == 0:
+                    self._tier_active.pop((scope, pid), None)
+            if not tier_entries:
+                del self._tier[scope]
+            self._tier_pid_arrays.pop(scope, None)
         return newcomer not in keep
 
     def _emit(self, scope: Scope, event: ConsensusEvent) -> None:
@@ -4543,6 +5273,11 @@ for _name in (
     "ingest_votes",
     "handle_consensus_timeout",
     "sweep_timeouts",
+    "demote_session",
+    "lifecycle_sweep",
+    "gc_sessions",
+    "pin_scope",
+    "unpin_scope",
     "get_proposal",
     "get_consensus_result",
     "get_active_proposals",

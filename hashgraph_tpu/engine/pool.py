@@ -576,6 +576,12 @@ class ProposalPool:
         """Host-mirrored lifecycle state (no device traffic)."""
         return int(self._state_host[slot])
 
+    def states_of(self, slots) -> np.ndarray:
+        """Vectorized :meth:`state_of` (host mirror gather, no device
+        traffic) — the bulk demotion/sweep paths read one array instead
+        of N accessor calls."""
+        return self._state_host[np.asarray(slots, np.int64)]
+
     def state_counts(self) -> dict[int, int]:
         """Histogram of slot states from the host mirror (stats path,
         reference: src/service_stats.rs:32-59)."""
@@ -1117,6 +1123,23 @@ class ProposalPool:
         state, yes, tot, mask, vals = _read_kernel(
             self._state, self._yes, self._tot, self._vote_mask, self._vote_val,
             jnp.asarray(slot, jnp.int32),
+        )
+        return dict(
+            state=np.asarray(state),
+            yes=np.asarray(yes),
+            tot=np.asarray(tot),
+            vote_mask=np.asarray(mask),
+            vote_val=np.asarray(vals),
+        )
+
+    def read_slots(self, slots) -> dict[str, np.ndarray]:
+        """Batched :meth:`read_slot`: ONE gather dispatch + transfer for
+        many slots (arrays indexed [k] in ``slots`` order). The bulk-export
+        path session demotion rides on — per-slot dispatches would make
+        tier churn O(sessions) device round-trips."""
+        state, yes, tot, mask, vals = _read_kernel(
+            self._state, self._yes, self._tot, self._vote_mask, self._vote_val,
+            jnp.asarray(np.asarray(slots, np.int32)),
         )
         return dict(
             state=np.asarray(state),

@@ -36,6 +36,8 @@ wal_fsync_seconds                               histogram  WAL writer (per fsync
 wal_recover_seconds                             histogram  DurableEngine.recover
 hashgraph_live_proposals                        gauge      engines (tracked sessions)
 hashgraph_vote_table_occupancy                  gauge      engines (claimed pool slots)
+hashgraph_tier_demoted_sessions / _tier_bytes   gauge      engines (demoted-tier population / bytes)
+hashgraph_tier_{demotions,promotions,gc}_total  counter    engine tier lifecycle traffic
 wal_segment_count / wal_segment_bytes           gauge      WAL writers (live log footprint)
 hashgraph_chain_suffix_length                   histogram  engine (votes applied per watermark extension)
 hashgraph_votes_total / _accepted_total         counter    engine ingest paths
@@ -135,6 +137,15 @@ LIVE_PROPOSALS = "hashgraph_live_proposals"
 VOTE_TABLE_OCCUPANCY = "hashgraph_vote_table_occupancy"
 WAL_SEGMENT_COUNT = "wal_segment_count"
 WAL_SEGMENT_BYTES = "wal_segment_bytes"
+
+# Tiered session lifecycle (engine demote/demand-page/GC): demoted-tier
+# population + serialized bytes (scrape-time gauges over every live
+# engine), and the demotion/promotion/GC traffic counters.
+TIER_DEMOTED_SESSIONS = "hashgraph_tier_demoted_sessions"
+TIER_BYTES = "hashgraph_tier_bytes"
+TIER_DEMOTIONS_TOTAL = "hashgraph_tier_demotions_total"
+TIER_PROMOTIONS_TOTAL = "hashgraph_tier_promotions_total"
+TIER_GC_TOTAL = "hashgraph_tier_gc_total"
 
 CHAIN_SUFFIX_LENGTH = "hashgraph_chain_suffix_length"
 
@@ -253,6 +264,8 @@ def _install_well_known(reg: MetricsRegistry) -> None:
     for name in (
         LIVE_PROPOSALS,
         VOTE_TABLE_OCCUPANCY,
+        TIER_DEMOTED_SESSIONS,
+        TIER_BYTES,
         WAL_SEGMENT_COUNT,
         WAL_SEGMENT_BYTES,
         JAX_LIVE_BUFFER_BYTES,
@@ -282,6 +295,9 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         VERIFY_CACHE_NEGATIVE_HITS_TOTAL,
         VERIFY_CACHE_EVICTIONS_TOTAL,
         VERIFIED_SIGNATURES_TOTAL,
+        TIER_DEMOTIONS_TOTAL,
+        TIER_PROMOTIONS_TOTAL,
+        TIER_GC_TOTAL,
         DEVICE_VERIFY_BATCHES_TOTAL,
         DEVICE_VERIFY_SIGNATURES_TOTAL,
         DEVICE_VERIFY_FALLBACKS_TOTAL,

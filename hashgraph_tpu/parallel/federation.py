@@ -61,6 +61,7 @@ from ..obs import (
     flight_recorder,
 )
 from ..obs import registry as default_registry
+from .rollup import aggregate_occupancy
 from .fleet import (
     ConsensusFleet,
     ShardMigratingError,
@@ -573,21 +574,9 @@ class FleetEngineAdapter:
 
     def occupancy(self) -> dict:
         """Aggregate capacity view (the per-shard breakdown lives on
-        ``fleet.occupancy()``)."""
-        live = device = spilled = capacity = 0
-        for entry in self._fleet.occupancy().values():
-            if entry.get("recovering") or entry.get("migrating"):
-                continue
-            live += entry.get("live_sessions", 0)
-            device += entry.get("device_slots_used", 0)
-            spilled += entry.get("host_spilled", 0)
-            capacity += entry.get("capacity", 0)
-        return {
-            "live_sessions": live,
-            "device_slots_used": device,
-            "host_spilled": spilled,
-            "capacity": capacity,
-        }
+        ``fleet.occupancy()``) — the shared rollup, so engine-level keys
+        (tier counters included) can never drift from the fleet's."""
+        return aggregate_occupancy(self._fleet.occupancy().values())
 
     def health_report(self, now=None) -> dict:
         return self._fleet.health_report(now)

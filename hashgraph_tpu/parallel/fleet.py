@@ -241,6 +241,8 @@ class FleetShard:
         # retired (or end_migration aborts).
         self.migrating = False
         self.migration_retry_after = 1.0
+        # Scopes pinned against lifecycle demote/GC for the freeze window.
+        self.migration_pinned: set = set()
         self.recovery_error: "BaseException | None" = None
         self.votes_routed = 0  # rows this shard was handed by the router
         # Last WAL replay's ReplayStats (recover_shard) — surfaced in
@@ -972,6 +974,15 @@ class ConsensusFleet:
             out[sid] = entry
         return out
 
+    def occupancy_totals(self) -> dict:
+        """Fleet-wide occupancy sum over the per-shard breakdown — the
+        shared rollup (:mod:`hashgraph_tpu.parallel.rollup`), so the
+        engine's keys (tier counters included) aggregate identically here
+        and on the federation adapter."""
+        from .rollup import aggregate_occupancy
+
+        return aggregate_occupancy(self.occupancy().values())
+
     def health_report(self, now=None) -> dict:
         """Per-shard health (each shard carries a private monitor, so one
         noisy shard's evidence never pollutes another's scorecards); each
@@ -1011,11 +1022,29 @@ class ConsensusFleet:
             raise ValueError(f"shard {shard_id!r} is not serving")
         shard.migration_retry_after = retry_after
         shard.migrating = True
+        # Freeze the tier too: pin every scope so no lifecycle sweep can
+        # demote/GC state while its snapshot+tail is being adopted (the
+        # fleet sweep already skips migrating shards; the pin also covers
+        # embedders driving the shard engine's sweep directly).
+        engine = getattr(shard.engine, "engine", shard.engine)
+        pin = getattr(engine, "pin_scope", None)
+        if pin is not None:
+            pinned = {scope for scope, _ in engine.session_keys()}
+            for scope in pinned:
+                pin(scope)
+            shard.migration_pinned = pinned
 
     def end_migration(self, shard_id: str) -> None:
         """Abort a migration freeze: the shard resumes serving locally
         (the placement never flipped, so no state moved)."""
-        self._shards[shard_id].migrating = False
+        shard = self._shards[shard_id]
+        shard.migrating = False
+        engine = getattr(shard.engine, "engine", shard.engine)
+        unpin = getattr(engine, "unpin_scope", None)
+        if unpin is not None:
+            for scope in getattr(shard, "migration_pinned", ()):
+                unpin(scope)
+            shard.migration_pinned = set()
 
     def pin_scope(self, scope, shard_id: str) -> None:
         """Pin ``scope`` to ``shard_id`` explicitly. The adopting side

@@ -1,0 +1,48 @@
+"""Shared occupancy rollup: one summation for every aggregate surface.
+
+``TpuConsensusEngine.occupancy()`` defines the per-engine capacity
+snapshot (live/device/spilled counts plus the demoted-tier counters).
+Fleet and federation both need the fleet-wide sum, and before this
+helper each hand-summed its own key set — a new engine key (like the
+tier counters) could silently go missing from one aggregate. Now the
+key set lives here once: extend ``OCCUPANCY_SUM_KEYS`` and every
+aggregate surface (fleet totals, the federation adapter, bench
+rollups) carries the new counter automatically.
+"""
+
+from __future__ import annotations
+
+# Engine occupancy keys that sum meaningfully across shards/hosts.
+# (voter_capacity deliberately excluded: it is a per-pool geometry, not
+# an additive capacity.)
+OCCUPANCY_SUM_KEYS = (
+    "live_sessions",
+    "device_slots_used",
+    "host_spilled",
+    "capacity",
+    "tier_sessions",
+    "tier_bytes",
+    "tier_demotions_total",
+    "tier_promotions_total",
+    "tier_gc_total",
+)
+
+
+def aggregate_occupancy(entries) -> dict:
+    """Sum per-shard ``occupancy()`` entries into one capacity view.
+
+    Shards that are mid-recovery or mid-migration report no counts (their
+    entries carry ``recovering``/``migrating`` flags instead); they are
+    skipped and surfaced as ``unavailable_shards`` so a rollup that hides
+    half the fleet says so.
+    """
+    out = {key: 0 for key in OCCUPANCY_SUM_KEYS}
+    unavailable = 0
+    for entry in entries:
+        if entry.get("recovering") or entry.get("migrating"):
+            unavailable += 1
+            continue
+        for key in OCCUPANCY_SUM_KEYS:
+            out[key] += entry.get(key, 0)
+    out["unavailable_shards"] = unavailable
+    return out

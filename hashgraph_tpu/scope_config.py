@@ -27,13 +27,25 @@ class NetworkType(enum.Enum):
 
 @dataclass
 class ScopeConfig:
-    """Per-scope defaults (reference: src/scope_config.rs:30-53)."""
+    """Per-scope defaults (reference: src/scope_config.rs:30-53).
+
+    ``demote_after`` / ``evict_decided_after`` are TPU-framework-specific
+    storage-tiering policies with no reference analogue (the reference's
+    only lifecycle is ``delete_scope``, src/storage.rs:92 — see PARITY.md):
+    ``demote_after`` seconds of inactivity move a session out of its
+    device slot / host record into the compact demoted tier (it pages
+    back transparently on any touch), and ``evict_decided_after`` seconds
+    after a session's deciding activity garbage-collect decided/failed
+    sessions outright. Both default to None = never (reference
+    behavior)."""
 
     network_type: NetworkType = NetworkType.GOSSIPSUB
     default_consensus_threshold: float = 2.0 / 3.0
     default_timeout: float = DEFAULT_TIMEOUT_SECONDS
     default_liveness_criteria_yes: bool = True
     max_rounds_override: int | None = None
+    demote_after: float | None = None
+    evict_decided_after: float | None = None
 
     def validate(self) -> None:
         """reference: src/scope_config.rs:57-69 — Some(0) override is only
@@ -49,6 +61,9 @@ class ScopeConfig:
                 and self.network_type == NetworkType.GOSSIPSUB
             ):
                 raise InvalidMaxRounds()
+        for ttl in (self.demote_after, self.evict_decided_after):
+            if ttl is not None and not ttl > 0:
+                raise ValueError("tier TTLs must be positive seconds (or None)")
 
     def clone(self) -> "ScopeConfig":
         return ScopeConfig(
@@ -57,6 +72,8 @@ class ScopeConfig:
             default_timeout=self.default_timeout,
             default_liveness_criteria_yes=self.default_liveness_criteria_yes,
             max_rounds_override=self.max_rounds_override,
+            demote_after=self.demote_after,
+            evict_decided_after=self.evict_decided_after,
         )
 
     @classmethod
@@ -94,6 +111,20 @@ class ScopeConfigBuilder:
 
     def with_max_rounds(self, max_rounds: int | None) -> "ScopeConfigBuilder":
         self._config.max_rounds_override = max_rounds
+        return self
+
+    def with_demote_after(self, seconds: float | None) -> "ScopeConfigBuilder":
+        """Idle/decided sessions demote to the compact tier after this
+        many seconds of inactivity (None = never; tiering off)."""
+        self._config.demote_after = seconds
+        return self
+
+    def with_evict_decided_after(
+        self, seconds: float | None
+    ) -> "ScopeConfigBuilder":
+        """Decided/failed sessions are garbage-collected outright this
+        many seconds after their deciding activity (None = never)."""
+        self._config.evict_decided_after = seconds
         return self
 
     def p2p_preset(self) -> "ScopeConfigBuilder":

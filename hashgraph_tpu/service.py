@@ -277,6 +277,8 @@ class ConsensusService(Generic[Scope]):
             existing.default_timeout = config.default_timeout
             existing.default_liveness_criteria_yes = config.default_liveness_criteria_yes
             existing.max_rounds_override = config.max_rounds_override
+            existing.demote_after = config.demote_after
+            existing.evict_decided_after = config.evict_decided_after
 
         self._storage.update_scope_config(scope, updater)
 
@@ -407,6 +409,14 @@ class ScopeConfigBuilderWrapper(Generic[Scope]):
 
     def with_max_rounds(self, max_rounds: int | None) -> "ScopeConfigBuilderWrapper[Scope]":
         self._builder.with_max_rounds(max_rounds)
+        return self
+
+    def with_demote_after(self, seconds: float | None) -> "ScopeConfigBuilderWrapper[Scope]":
+        self._builder.with_demote_after(seconds)
+        return self
+
+    def with_evict_decided_after(self, seconds: float | None) -> "ScopeConfigBuilderWrapper[Scope]":
+        self._builder.with_evict_decided_after(seconds)
         return self
 
     def p2p_preset(self) -> "ScopeConfigBuilderWrapper[Scope]":

@@ -24,6 +24,8 @@ The corpus (≥ the ISSUE's eight):
 - ``columnar-wire-storm``   — mutated OP_VOTE_BATCH frames convicted by the
   COLUMNAR wire validator (zero-copy server path, wire_columnar pinned on)
 - ``timeout-liveness``      — embedder timeouts decide identically everywhere
+- ``tiered-crash-recovery`` — kill-9 with demoted sessions (WAL recovery) +
+  lost-disk catch-up from tiered sources, fingerprint equality throughout
 
 A corpus run can also prove the harness is not blind to itself:
 ``blind=True`` disables the health/evidence layer (the deliberately
@@ -394,6 +396,75 @@ def _roll_deploy(c: SimCluster):
     }
 
 
+def _tiered_crash_recovery(c: SimCluster):
+    """Storage tiering under crashes: a peer DEMOTES decided history to
+    its serialized tier, demand-pages one session back under live
+    traffic, is kill-9'd with the rest still demoted, and WAL-recovers
+    to fingerprint equality (the tier is a rebuildable cache — recovery
+    legitimately rebuilds demoted sessions as live, and the
+    order-insensitive fingerprint cannot tell). A second victim then
+    loses its DISK and rejoins through snapshot+tail catch-up served
+    from tiered sources — the snapshot build must read straight through
+    the tier."""
+    history = [c.create_session(c.peer(k % 3), f"hist-{k}") for k in range(5)]
+    for session in history:
+        c.vote_all(session)
+    victim = c.peer(1)
+    demoted = sum(
+        bool(victim.engine.demote_session(s.scope, s.pid)) for s in history
+    )
+    # Demand-page under traffic: a live session is demoted mid-vote and
+    # the next votes (incl. the victim's own cast) must promote + apply
+    # exactly as if it had never left.
+    live = c.create_session(c.peer(0), "live")
+    for i in (0, 2):
+        c.cast_vote(live, c.peer(i), True)
+    victim.engine.demote_session(live.scope, live.pid)
+    promotions0 = victim.engine.occupancy()["tier_promotions_total"]
+    for i in (1, 3):
+        c.cast_vote(live, c.peer(i), True)
+    promotions = victim.engine.occupancy()["tier_promotions_total"] - promotions0
+    tier_at_crash = victim.engine.occupancy()["tier_sessions"]
+    victim.crash()
+    while_down = c.create_session(c.peer(2), "while-down")
+    c.vote_all(while_down)
+    victim.restart()  # WAL recovery with demoted history in the log
+    recovery = victim.last_recovery
+    c.cast_vote(while_down, victim, True)
+    # Lost-disk joiner: every surviving source demotes the history, so
+    # the catch-up snapshot is built from tiered engines.
+    joiner = c.peer(3)
+    for peer in c.live_peers():
+        if peer is joiner:
+            continue
+        for session in history:
+            try:
+                peer.engine.demote_session(session.scope, session.pid)
+            except Exception:
+                pass  # already demoted / evicted — the tier is policy
+    joiner.crash()
+    joiner.restart(wipe=True)
+    joiner.node.anti_entropy(c.now)
+    c.run_network()
+    occupancy = joiner.engine.occupancy()
+    return {}, {
+        "history_demoted": demoted >= 4,
+        "demand_page_promoted": promotions >= 1,
+        "demoted_at_crash": tier_at_crash >= 1,
+        "recovery_clean": not recovery.errors and recovery.segments_dropped == 0,
+        "recovery_replayed_records": recovery.records_applied > 0,
+        "catchup_escalated": c.catchups >= 1,
+        "joiner_reinstalled_history": occupancy.get("live_sessions", 0)
+        + occupancy.get("tier_sessions", 0) >= 5,
+    }, {
+        "demoted": demoted,
+        "tier_at_crash": tier_at_crash,
+        "promotions": promotions,
+        "records_replayed": recovery.records_applied,
+        "catchups": c.catchups,
+    }
+
+
 def _timeout_liveness(c: SimCluster):
     # expected_voters past the live peer count: the session can only
     # decide through the embedder's timeout duty.
@@ -443,6 +514,12 @@ SCENARIOS: "dict[str, _Spec]" = {
     # cross-host fingerprint equality after the last heal.
     "roll-deploy": _Spec(_roll_deploy),
     "timeout-liveness": _Spec(_timeout_liveness),
+    # Kill-9 of a peer holding DEMOTED sessions (WAL recovery), plus a
+    # lost-disk joiner catching up from tiered sources — the storage-
+    # tiering acceptance: the tier is a cache, fingerprints cannot tell.
+    "tiered-crash-recovery": _Spec(
+        _tiered_crash_recovery, escalate_sessions=4
+    ),
 }
 
 

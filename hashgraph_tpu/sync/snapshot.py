@@ -124,22 +124,54 @@ def encode_frame(item_kind: int, payload: bytes) -> bytes:
     return _HEADER.pack(len(body), zlib.crc32(body)) + body
 
 
-def encode_session_item(scope, session: ConsensusSession) -> bytes:
-    state = _STATE_CODE[session.state.kind]
-    result = 1 if session.state.result else 0
+# C-level packers for the bulk path (byte-identical to _u8/_u64/_u32
+# sequences: little-endian "<" structs never pad).
+_SRC_PACK = struct.Struct("<BBQ").pack  # state | result | created_at
+_U32_PACK = struct.Struct("<I").pack
+
+
+def encode_session_fields(
+    scope_bytes: bytes,
+    state: int,
+    result: bool,
+    created_at: int,
+    config_bytes: bytes,
+    tallies,
+    proposal_wire: bytes,
+) -> bytes:
+    """ITEM_SESSION payload from pre-resolved components — the layout
+    :func:`encode_session_item` delegates to. Callers that already hold
+    the canonical pieces (the engine's bulk demotion path: per-call
+    memoized scope/config encodes, tallies straight off the device row,
+    the live proposal's wire bytes) skip materializing a scalar
+    ConsensusSession per item; byte-identity with the session-object
+    path is pinned by the tier fingerprint property suite."""
     out = [
-        F.encode_scope(scope),
-        _u8(state),
-        _u8(result),
-        _u64(session.created_at),
-        F.encode_consensus_config(session.config),
-        _u32(len(session.tallies)),
+        scope_bytes,
+        _SRC_PACK(state, 1 if result else 0, created_at),
+        config_bytes,
+        _U32_PACK(len(tallies)),
     ]
-    for owner, value in session.tallies.items():
-        out.append(_blob(owner))
-        out.append(_u8(1 if value else 0))
-    out.append(_blob(session.proposal.encode()))
+    append = out.append
+    for owner, value in tallies.items():
+        append(_U32_PACK(len(owner)))
+        append(bytes(owner))
+        append(b"\x01" if value else b"\x00")
+    append(_U32_PACK(len(proposal_wire)))
+    append(proposal_wire)
     return b"".join(out)
+
+
+def encode_session_item(scope, session: ConsensusSession) -> bytes:
+    return encode_session_fields(
+        F.encode_scope(scope),
+        _STATE_CODE[session.state.kind],
+        bool(session.state.result),
+        session.created_at,
+        F.encode_consensus_config(session.config),
+        session.tallies,
+        session.proposal.encode(),
+    )
 
 
 def decode_session_item(payload: bytes) -> "tuple[object, ConsensusSession]":

@@ -634,9 +634,40 @@ class DurableEngine:
             return self._engine.handle_consensus_timeout(scope, proposal_id, now)
 
     def sweep_timeouts(self, now):
+        """Timeout sweep + tier lifecycle, logged in two parts: the
+        KIND_SWEEP record (before the apply — the timeout half replays
+        deterministically from persisted expiries) and, when the
+        lifecycle hook garbage-collected anything, a KIND_GC record of
+        the exact keys (after the apply, before the ack — the TTL
+        decision rides idle clocks a snapshot restore does not carry, so
+        replay applies the logged outcome instead of re-deriving the
+        policy; see format.KIND_GC). A crash between apply and GC-log
+        merely leaves the collected sessions to be re-collected by the
+        recovered engine's next sweep."""
         with self._lock:
             self._wal.append(F.KIND_SWEEP, F.encode_sweep(now))
-            return self._engine.sweep_timeouts(now)
+            sink: list = []
+            out = self._engine.sweep_timeouts(now, _gc_sink=sink)
+            if sink:
+                self._wal.append(F.KIND_GC, F.encode_gc(sink))
+            return out
+
+    def lifecycle_sweep(self, now):
+        """Standalone tier sweep, logged like :meth:`sweep_timeouts`'s
+        lifecycle half (KIND_LIFECYCLE + the KIND_GC outcome): its TTL
+        GC is semantic — demoted sessions past ``evict_decided_after``
+        cease to exist — so an unlogged call would let a crash resurrect
+        sessions the live engine already dropped. ``demote_session``
+        stays unlogged by design — demotion is cache management, and
+        recovery rebuilding a demoted session as live is
+        fingerprint-identical."""
+        with self._lock:
+            self._wal.append(F.KIND_LIFECYCLE, F.encode_lifecycle(now))
+            sink: list = []
+            out = self._engine.lifecycle_sweep(now, _gc_sink=sink)
+            if sink:
+                self._wal.append(F.KIND_GC, F.encode_gc(sink))
+            return out
 
     # ── Scope config ───────────────────────────────────────────────────
 

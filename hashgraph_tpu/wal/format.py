@@ -58,6 +58,25 @@ KIND_DELIVER = 9
 # recovered peer would silently drop the cross-frame dangling-vote guard
 # its non-crashed twins keep.
 KIND_WIRE_COLUMNAR = 10
+# Standalone engine.lifecycle_sweep call (tier demote/GC at the
+# embedder's clock). Demotion alone would be replay-neutral (the tier is
+# a cache), but the sweep's TTL GC is semantic — sessions past
+# evict_decided_after cease to exist — so an unlogged sweep would let a
+# crash resurrect sessions the live engine already dropped. Sweeps that
+# ride sweep_timeouts are covered by KIND_SWEEP.
+KIND_LIFECYCLE = 11
+# TTL-GC OUTCOME of the immediately preceding KIND_SWEEP/KIND_LIFECYCLE
+# record: the exact (scope, pid) set the live sweep collected. Logged
+# after the apply, before the ack (the columnar discipline), because the
+# GC decision depends on per-session idle clocks a SNAPSHOT restore does
+# not carry (last_activity is deliberately absent from the fingerprinted
+# session item — two converged peers with different local clocks must
+# not fingerprint-diverge): re-deriving the TTL policy on replay over
+# restored clocks could collect a different set than the live engine
+# did. Replay therefore applies the logged outcome verbatim
+# (engine.gc_sessions), while recovery's replay mode suppresses the
+# re-derived lifecycle policy inside KIND_SWEEP/KIND_LIFECYCLE.
+KIND_GC = 12
 
 KIND_NAMES = {
     KIND_PROPOSALS: "proposals",
@@ -70,6 +89,8 @@ KIND_NAMES = {
     KIND_SNAPSHOT: "snapshot",
     KIND_DELIVER: "deliver",
     KIND_WIRE_COLUMNAR: "wire_columnar",
+    KIND_LIFECYCLE: "lifecycle",
+    KIND_GC: "gc",
 }
 
 # Scope-config record modes (the engine has three distinct mutation
@@ -245,6 +266,12 @@ _NT_P2P = 1
 
 def encode_scope_config(config: ScopeConfig) -> bytes:
     override = config.max_rounds_override
+    # Tier-TTL presence flags: absent TTLs encode as flag 0 + value 0.0 so
+    # the layout stays fixed-width and canonical (fingerprints hash these
+    # bytes — two equal configs must encode identically).
+    tier_flags = (0 if config.demote_after is None else 1) | (
+        0 if config.evict_decided_after is None else 2
+    )
     return b"".join(
         (
             _u8(_NT_P2P if config.network_type == NetworkType.P2P else _NT_GOSSIPSUB),
@@ -253,6 +280,9 @@ def encode_scope_config(config: ScopeConfig) -> bytes:
             _u8(1 if config.default_liveness_criteria_yes else 0),
             _u8(0 if override is None else 1),
             _u32(override or 0),
+            _u8(tier_flags),
+            _f64(config.demote_after or 0.0),
+            _f64(config.evict_decided_after or 0.0),
         )
     )
 
@@ -264,12 +294,17 @@ def decode_scope_config(r: Reader) -> ScopeConfig:
     liveness = bool(r.u8())
     has_override = bool(r.u8())
     override = r.u32()
+    tier_flags = r.u8()
+    demote_after = r.f64()
+    evict_decided_after = r.f64()
     return ScopeConfig(
         network_type=nt,
         default_consensus_threshold=threshold,
         default_timeout=timeout,
         default_liveness_criteria_yes=liveness,
         max_rounds_override=override if has_override else None,
+        demote_after=demote_after if tier_flags & 1 else None,
+        evict_decided_after=evict_decided_after if tier_flags & 2 else None,
     )
 
 
@@ -455,6 +490,21 @@ def decode_timeout(payload: bytes) -> tuple[object, int, int]:
 
 def encode_sweep(now: int) -> bytes:
     return _u64(now)
+
+
+# KIND_LIFECYCLE shares the sweep payload: one u64 logical timestamp.
+encode_lifecycle = encode_sweep
+
+
+def encode_gc(keys: list) -> bytes:
+    return _u32(len(keys)) + b"".join(
+        encode_scope(scope) + _u64(pid) for scope, pid in keys
+    )
+
+
+def decode_gc(payload: bytes) -> list:
+    r = Reader(payload)
+    return [(decode_scope(r), r.u64()) for _ in range(r.u32())]
 
 
 def decode_sweep(payload: bytes) -> int:

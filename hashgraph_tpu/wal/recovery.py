@@ -382,5 +382,18 @@ def _apply(engine, kind: int, payload: bytes, stats: ReplayStats) -> None:
         engine.handle_consensus_timeout(scope, pid, now)
     elif kind == F.KIND_SWEEP:
         engine.sweep_timeouts(F.decode_sweep(payload))
+    elif kind == F.KIND_LIFECYCLE:
+        # Standalone tier sweep. Under recovery's replay mode the
+        # engine's lifecycle hook is a no-op — the live run's TTL GC
+        # arrives as the following KIND_GC record — so this replays the
+        # call for engines replaying OUTSIDE replay mode (direct
+        # replay() use, where live-path clock reconstruction makes the
+        # policy re-derivable) and is otherwise inert.
+        engine.lifecycle_sweep(F.decode_sweep(payload))
+    elif kind == F.KIND_GC:
+        # The live sweep's exact TTL-GC outcome (see format.KIND_GC):
+        # applied verbatim, idempotent for keys a re-derived sweep
+        # already collected.
+        engine.gc_sessions(F.decode_gc(payload))
     else:
         raise ValueError(f"unknown WAL record kind {kind}")

@@ -316,6 +316,34 @@ class Proposal:
         _encode_bool_field(out, 19, self.liveness_criteria_yes)
         return bytes(out)
 
+    def encode_split(self) -> tuple[bytes, bytes]:
+        """``(head, tail)`` such that ``head + <field 12: proposal_id> +
+        tail`` equals :meth:`encode` byte for byte, for a VOTE-FREE
+        proposal (field 14 sits between the id and the tail; embedded
+        votes make the split ambiguous and raise). Bulk serializers (the
+        engine's session-demotion path) cache the two constant parts per
+        distinct (name, payload, owner, n, round, timestamps, liveness)
+        shape and splice only the id varint per proposal — the canonical
+        bytes without re-walking nine fields per item. Parity with
+        ``encode`` is pinned by tests/test_wire.py."""
+        if self.votes:
+            raise ValueError("encode_split requires a vote-free proposal")
+        head = bytearray()
+        if self.name:
+            name_bytes = self.name.encode("utf-8")
+            _encode_tag(head, 10, _LEN)
+            _encode_varint(head, len(name_bytes))
+            head += name_bytes
+        _encode_bytes_field(head, 11, self.payload)
+        tail = bytearray()
+        _encode_bytes_field(tail, 13, self.proposal_owner)
+        _encode_uint_field(tail, 15, self.expected_voters_count & _U32_MASK)
+        _encode_uint_field(tail, 16, self.round & _U32_MASK)
+        _encode_uint_field(tail, 17, self.timestamp & _U64_MASK)
+        _encode_uint_field(tail, 18, self.expiration_timestamp & _U64_MASK)
+        _encode_bool_field(tail, 19, self.liveness_criteria_yes)
+        return bytes(head), bytes(tail)
+
     @classmethod
     def decode(cls, data: bytes) -> "Proposal":
         proposal = cls()

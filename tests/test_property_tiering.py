@@ -1,0 +1,36 @@
+"""Hypothesis search over the tiered-vs-untier'd identity op space.
+
+The script runner (and the always-on seeded trials) live in
+tests/test_tiering.py; this file lets hypothesis hunt the op space —
+shrinking to a minimal counterexample — wherever the dev extra is
+installed (importorskips cleanly elsewhere, like the other property
+suites).
+"""
+
+import pytest
+
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings, strategies as st
+
+from test_tiering import run_identity_script
+
+_op = st.one_of(
+    st.tuples(st.just("create"), st.integers(1, 4)),
+    st.tuples(
+        st.just("vote"),
+        st.integers(0, 7),  # session pick (mod live)
+        st.integers(0, 3),  # signer
+        st.booleans(),
+    ),
+    st.tuples(st.just("timeout"), st.integers(0, 7)),
+    st.tuples(st.just("sweep"), st.integers(1, 30)),
+    st.tuples(st.just("demote"), st.integers(0, 7)),
+    st.tuples(st.just("demote_all")),
+)
+
+
+@settings(max_examples=20, deadline=None)
+@given(script=st.lists(_op, min_size=3, max_size=20))
+def test_tiered_untiered_decision_identity(script):
+    run_identity_script(script)

@@ -525,3 +525,163 @@ if _HAS_HYPOTHESIS:
                 torn if point == "append" else 0,
                 seed=seed,
             )
+
+
+class TestTieredCompactionInterplay:
+    """Demotion × checkpoint/compaction: a checkpoint taken while
+    sessions sleep in the demoted tier covers them (save_to_storage
+    reads through the tier), so compacting to the watermark and
+    crashing must recover them byte-identical — and a late vote on a
+    recovered formerly-demoted session still applies."""
+
+    def _decided_proposal(self, durable, scope, rng, t):
+        request = CreateProposalRequest(
+            name=f"d{rng.randrange(1 << 30)}",
+            payload=os.urandom(rng.randrange(0, 12)),
+            proposal_owner=b"owner",
+            expected_voters_count=1,  # unanimity: one vote decides
+            expiration_timestamp=50,
+            liveness_criteria_yes=True,
+        )
+        proposal = durable.create_proposal(scope, request, t)
+        chain = proposal.clone()
+        signer = StubConsensusSigner(os.urandom(20))
+        vote = build_vote(chain, True, signer, t)
+        durable.process_incoming_vote(scope, vote, t)
+        return proposal
+
+    def test_demote_checkpoint_compact_crash_recover(self, tmp_path):
+        rng = random.Random(0x7157)
+        identity = os.urandom(20)
+        durable = DurableEngine(
+            _fresh_engine(identity),
+            str(tmp_path / "wal"),
+            fsync_policy="off",
+            segment_bytes=512,
+        )
+        pids = []
+        for k in range(6):
+            proposal = self._decided_proposal(durable, f"s{k % 2}", rng, NOW)
+            pids.append((f"s{k % 2}", proposal.proposal_id))
+        # Demote half of them (unlogged by design: the tier is a cache).
+        for scope, pid in pids[:3]:
+            assert durable.demote_session(scope, pid) is True
+        fp_live = state_fingerprint(durable)
+        assert durable.occupancy()["tier_sessions"] == 3
+
+        # Checkpoint + compact at the watermark: the snapshot must carry
+        # the demoted sessions, because compaction deletes the only other
+        # copy of their history.
+        storage = InMemoryConsensusStorage()
+        durable.checkpoint(storage, compact=True)
+        assert len(list_segments(str(tmp_path / "wal"))) == 1
+
+        # Traffic after the checkpoint, then kill -9.
+        late = self._decided_proposal(durable, "s0", rng, NOW + 1)
+        pids.append(("s0", late.proposal_id))
+        fp_pre_crash = state_fingerprint(durable)
+        durable.abandon()
+
+        recovered = DurableEngine(
+            _fresh_engine(identity), str(tmp_path / "wal"), fsync_policy="off"
+        )
+        stats = recovered.recover(storage)
+        assert not stats.errors and stats.segments_dropped == 0
+        # Byte-identical state: the demoted sessions came back through
+        # the snapshot (as live sessions — the tier is a cache, and the
+        # order-insensitive fingerprint cannot tell).
+        assert state_fingerprint(recovered) == fp_pre_crash
+        assert fp_pre_crash != fp_live  # the post-checkpoint traffic counts
+
+        # A late vote on a formerly-demoted (recovered) session applies.
+        scope, pid = pids[0]
+        session = recovered.export_session(scope, pid)
+        assert session.state.is_reached
+        chain = recovered.get_proposal(scope, pid)
+        extra = build_vote(chain, False, StubConsensusSigner(b"\x77" * 20), NOW + 2)
+        statuses = recovered.ingest_votes([(scope, extra)], NOW + 2)
+        assert int(statuses[0]) == 28  # ALREADY_REACHED: absorbed late vote
+        recovered.close()
+
+    def test_standalone_lifecycle_sweep_is_logged(self, tmp_path):
+        """lifecycle_sweep outside sweep_timeouts GCs sessions — that is
+        semantic, so the wrapper logs it (KIND_LIFECYCLE) and replay
+        re-runs it: a crash must not resurrect GC'd sessions."""
+        rng = random.Random(0xC0)
+        identity = os.urandom(20)
+        durable = DurableEngine(
+            _fresh_engine(identity), str(tmp_path), fsync_policy="off"
+        )
+        durable.set_scope_config(
+            "s0", ScopeConfig(demote_after=5.0, evict_decided_after=10.0)
+        )
+        proposal = self._decided_proposal(durable, "s0", rng, NOW)
+        out = durable.lifecycle_sweep(NOW + 7)
+        assert out["demoted"] == 1
+        out = durable.lifecycle_sweep(NOW + 30)
+        assert out["gc_tier"] == 1
+        fp = state_fingerprint(durable)
+        durable.abandon()
+
+        recovered = DurableEngine(
+            _fresh_engine(identity), str(tmp_path), fsync_policy="off"
+        )
+        stats = recovered.recover()
+        assert not stats.errors
+        assert state_fingerprint(recovered) == fp
+        try:
+            recovered.get_consensus_result("s0", proposal.proposal_id)
+            raise AssertionError("GC'd session resurrected by replay")
+        except SessionNotFound:
+            pass
+        recovered.close()
+
+    def test_ttl_gc_exact_across_snapshot_restore(self, tmp_path):
+        """The review scenario: a session DECIDED long after creation,
+        checkpointed, then swept in the WAL tail at a clock where
+        (now - created_at) >= TTL > (now - last_activity). The live
+        engine keeps it (idle clock runs from the deciding vote); a
+        recovered engine restores last_activity from the snapshot's
+        created_at — so replay must apply the live run's logged GC
+        OUTCOME (KIND_GC: empty here), never re-derive the policy, or
+        it would collect a session the live engine still serves."""
+        rng = random.Random(0x6C)
+        identity = os.urandom(20)
+        durable = DurableEngine(
+            _fresh_engine(identity), str(tmp_path), fsync_policy="off"
+        )
+        durable.set_scope_config("s0", ScopeConfig(evict_decided_after=50.0))
+        request = CreateProposalRequest(
+            name="slowpoke",
+            payload=b"x",
+            proposal_owner=b"owner",
+            expected_voters_count=1,
+            expiration_timestamp=500,
+            liveness_criteria_yes=True,
+        )
+        proposal = durable.create_proposal("s0", request, NOW)  # t0
+        t_decide = NOW + 100
+        vote = build_vote(
+            proposal.clone(), True, StubConsensusSigner(os.urandom(20)), t_decide
+        )
+        durable.process_incoming_vote("s0", vote, t_decide)  # last activity
+        storage = InMemoryConsensusStorage()
+        durable.checkpoint(storage, compact=True)
+        # Logged sweep at t3: t3 - t_decide < 50 <= t3 - t0 — live keeps it.
+        t3 = NOW + 130
+        out = durable.lifecycle_sweep(t3)
+        assert out == {"demoted": 0, "gc_live": 0, "gc_tier": 0}
+        assert durable.get_consensus_result("s0", proposal.proposal_id) is True
+        fp = state_fingerprint(durable)
+        durable.abandon()
+
+        recovered = DurableEngine(
+            _fresh_engine(identity), str(tmp_path), fsync_policy="off"
+        )
+        stats = recovered.recover(storage)
+        assert not stats.errors
+        assert state_fingerprint(recovered) == fp
+        assert (
+            recovered.get_consensus_result("s0", proposal.proposal_id) is True
+        )
+        recovered.close()

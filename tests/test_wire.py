@@ -72,6 +72,29 @@ class TestRoundtrip:
         extra = bytes([(5 << 3) | 0, 0x05]) + full_vote().encode()
         assert Vote.decode(extra) == full_vote()
 
+    def test_encode_split_parity(self):
+        # head + <field 12> + tail must equal encode() byte for byte for
+        # any vote-free proposal — the bulk-demotion template contract.
+        from hashgraph_tpu.wire import _encode_uint_field
+
+        p = full_proposal()
+        p.votes = []
+        for pid in (0, 1, 127, 128, 2**31, 2**32 - 1):
+            p.proposal_id = pid
+            head, tail = p.encode_split()
+            buf = bytearray(head)
+            _encode_uint_field(buf, 12, pid)
+            assert bytes(buf) + tail == p.encode()
+        sparse = Proposal(name="", payload=b"", proposal_id=9)
+        head, tail = sparse.encode_split()
+        buf = bytearray(head)
+        _encode_uint_field(buf, 12, 9)
+        assert bytes(buf) + tail == sparse.encode()
+
+    def test_encode_split_rejects_embedded_votes(self):
+        with pytest.raises(ValueError):
+            full_proposal().encode_split()
+
 
 class TestProstCompatibility:
     """Encode with google.protobuf against the same schema and compare bytes.
