@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-federation bench-catchup bench-gossip bench-chaos bench-churn bench-device-verify fleet-smoke federation-smoke catchup-smoke gossip-smoke chaos-smoke churn-smoke metrics-smoke trace-smoke smoke
+.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-federation bench-catchup bench-gossip bench-chaos bench-churn bench-device-verify bench-slo-overhead fleet-smoke federation-smoke catchup-smoke gossip-smoke chaos-smoke churn-smoke metrics-smoke trace-smoke federation-scrape-smoke slo-overhead-smoke smoke obs-smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -148,5 +148,28 @@ metrics-smoke:
 trace-smoke:
 	JAX_PLATFORMS=cpu python examples/trace_smoke.py
 
-# Aggregate observability smoke: everything above in one target.
+# Metric-federation check: 2 federation hosts as OS processes, one
+# decision each, then OP_METRICS_PULL frames merged into ONE scrape —
+# both hosts' families labelled host="...", fleet-total bare series,
+# merged /slo rollup — served over a real HTTP sidecar. See
+# examples/federation_scrape_smoke.py.
+federation-scrape-smoke:
+	JAX_PLATFORMS=cpu python examples/federation_scrape_smoke.py
+
+# Always-on SLO tracking cost: paired interleaved A/B (SLO engine
+# enabled vs disabled) on a decision-heavy workload; the verdict holds
+# the median overhead under the 5% acceptance bar, noise-aware.
+bench-slo-overhead:
+	JAX_PLATFORMS=cpu python bench.py slo-overhead
+
+# CI short run of the same A/B at tiny shapes.
+slo-overhead-smoke:
+	JAX_PLATFORMS=cpu python bench.py slo-overhead --smoke
+
+# Aggregate observability smoke: single-process scrape + trace paths.
 smoke: metrics-smoke trace-smoke
+
+# Fleet-wide observability plane smoke: everything `smoke` covers plus
+# the federated merged scrape and the SLO-overhead A/B — the CI
+# `obs-smoke` job's target.
+obs-smoke: smoke federation-scrape-smoke slo-overhead-smoke

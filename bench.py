@@ -18,7 +18,8 @@ BASELINE shape:
 
 Individual runs via argv: engine | pool (alias config3) | config2 |
 config4 | config5 | lanes1024 | crypto | validated | redelivery | wal |
-fleet | default | all (``all`` prints newline-separated JSON, one line
+fleet | slo-overhead | default | all (``all`` prints newline-separated
+JSON, one line
 per section). ``wal`` measures the durability subsystem: append
 throughput per fsync policy, DurableEngine ingest overhead vs a bare
 engine, and recovery replay rate (host-only — not part of the BASELINE
@@ -29,7 +30,15 @@ signatures. ``fleet`` measures the scope-sharded fleet
 (hashgraph_tpu.parallel.ConsensusFleet): an aggregate votes/sec headline
 across all local devices with a per-shard breakdown, a paired fleet-vs-
 single-shard A/B ``noise_verdict``, and a MULTICHIP-compatible record;
-``fleet --smoke`` is the 2-shard CI short run.
+``fleet --smoke`` is the 2-shard CI short run. Decision-driving benches
+(``fleet``, ``gossip``, ``churn``, ``fleet --hosts N``) add an ``slo``
+block to their JSON — windowed p50/p95/p99 decide latency plus a
+burn-rate verdict from :mod:`hashgraph_tpu.obs.slo`; ``slo-overhead``
+is the paired A/B asserting always-on SLO tracking costs under 5%
+throughput. The federated fleet bench additionally scrapes the merged
+``/metrics`` + ``/slo`` views and induces one SLO breach to assert the
+alert fires and an exemplar-linked Perfetto incident dump lands on the
+owning host.
 
 JAX's persistent compilation cache is ON BY DEFAULT at
 ``~/.cache/hashgraph_tpu/xla-cache`` (re-runs at the same geometry skip
@@ -73,6 +82,43 @@ def spread_pct(vals: "list[float]") -> float:
     vals = sorted(vals)
     mid = vals[len(vals) // 2]
     return round(100.0 * (vals[-1] - vals[0]) / mid, 1) if mid else 0.0
+
+
+def _slo_block(objective_ms: "float | None" = None) -> dict:
+    """Windowed decision-latency quantiles + an SLO verdict from the
+    process-global SloEngine — the ``slo`` block the decision-driving
+    benches (fleet / gossip / churn) append to their BENCH_*.json.
+
+    ``objective_ms`` is the bench's declared decide-latency objective:
+    the verdict passes when the fast-window global p99 meets it AND no
+    burn-rate alert is firing at readout."""
+    from hashgraph_tpu.obs import slo_engine
+
+    state = slo_engine.state()
+    window = state["global"]
+    block = {
+        "windowed_latency_ms": {
+            "count": window["count"],
+            "p50": round(window["p50"] * 1e3, 3),
+            "p95": round(window["p95"] * 1e3, 3),
+            "p99": round(window["p99"] * 1e3, 3),
+        },
+        "per_shard_p99_ms": {
+            sid: round(s["p99"] * 1e3, 3)
+            for sid, s in state["shards"].items()
+        },
+        "alerts_firing": state["alerts_firing"],
+    }
+    if objective_ms is not None:
+        block["verdict"] = {
+            "objective_ms": objective_ms,
+            "p99_ms": block["windowed_latency_ms"]["p99"],
+            "pass": bool(
+                not state["alerts_firing"]
+                and window["p99"] * 1e3 <= objective_ms
+            ),
+        }
+    return block
 
 
 def run_bench(
@@ -2220,6 +2266,9 @@ def run_churn(
 
     from hashgraph_tpu import CreateProposalRequest, ScopeConfig, StubConsensusSigner
     from hashgraph_tpu.engine import TpuConsensusEngine
+    from hashgraph_tpu.obs import slo_engine
+
+    slo_engine.reset()
 
     now0 = 1_700_000_000
     wave_sessions = scopes * per_scope
@@ -2424,6 +2473,7 @@ def run_churn(
                 "evict_decided_after_ticks": evict_after,
             },
             "noise_verdict": noise_verdict,
+            "slo": _slo_block(objective_ms=5_000.0),
             "platform": jax.devices()[0].platform,
         },
     }
@@ -2667,6 +2717,10 @@ def run_gossip(
                 stage_reps.append(stage_delta(before, scrape_stages()))
             controls.append(control_rate())
         final_stages = scrape_stages() if stages else None
+        # One OP_METRICS_PULL frame per peer: each process's windowed
+        # SLO state rides home with the bench (the peers decided the
+        # sessions, so THEIR SloEngines hold the latency windows).
+        slo_frames = [client.metrics_pull() for client in clients]
 
         # Smoke convergence phase: sampled fanout misses peers on
         # purpose; ONE anti-entropy round (same logical now) repairs
@@ -2744,6 +2798,11 @@ def run_gossip(
             "control": spread_pct(controls),
         },
     }
+    from hashgraph_tpu.parallel.rollup import merge_slo_states
+
+    merged_slo = merge_slo_states(slo_frames)
+    slo_objective_ms = 5_000.0
+    worst_p99_ms = round(merged_slo["global"]["worst_p99"] * 1e3, 3)
     detail = {
         "n_peers": n_peers,
         "proposals": p_count,
@@ -2752,6 +2811,27 @@ def run_gossip(
         "votes_networked_per_rep": networked,
         "fingerprints_identical": True,  # asserted every rep, both arms
         "noise_verdict": noise_verdict,
+        "slo": {
+            "windowed_decisions": merged_slo["global"]["count"],
+            "worst_peer_p99_ms": worst_p99_ms,
+            "per_peer_latency_ms": {
+                host: {
+                    "p50": round(s["global"]["p50"] * 1e3, 3),
+                    "p95": round(s["global"]["p95"] * 1e3, 3),
+                    "p99": round(s["global"]["p99"] * 1e3, 3),
+                }
+                for host, s in merged_slo["hosts"].items()
+            },
+            "alerts_firing": merged_slo["alerts_firing"],
+            "verdict": {
+                "objective_ms": slo_objective_ms,
+                "p99_ms": worst_p99_ms,
+                "pass": bool(
+                    not merged_slo["alerts_firing"]
+                    and worst_p99_ms <= slo_objective_ms
+                ),
+            },
+        },
     }
     if stages and stage_reps:
         # Per-rep wall seconds inside the fabric arm's server path (wire
@@ -2826,6 +2906,9 @@ def run_fleet(
     )
     from hashgraph_tpu.parallel import ConsensusFleet
 
+    from hashgraph_tpu.obs import slo_engine
+
+    slo_engine.reset()
     rng = np.random.default_rng(31)
     now = 1_700_000_000
     if smoke:
@@ -2890,6 +2973,12 @@ def run_fleet(
             builder = (
                 builder.p2p_preset() if i % 2 else builder.gossipsub_preset()
             )
+            # A declared decide-latency objective on every bench scope:
+            # the SLO plane tracks the run end to end (per-scope burn
+            # windows, alert machinery live) and the BENCH json carries
+            # a windowed-p99 verdict against it. Generous on purpose —
+            # a CI box breaching 5s would be a real regression.
+            builder = builder.with_decide_p99_ms(5_000.0)
             fleet.set_scope_config(scope, builder.build())
         t0 = time.perf_counter()
         pids = {}
@@ -3072,6 +3161,7 @@ def run_fleet(
         "votes": headline_rep["votes"],
         "tally_path": "psum" if fleet._tally() is not None else "host-sum",
     }
+    slo = _slo_block(objective_ms=5_000.0)
     fleet.close()
     return {
         "metric": "fleet_aggregate_ingest_throughput",
@@ -3093,6 +3183,7 @@ def run_fleet(
             "state_counts": headline_rep["state_counts"],
             "noise_verdict": noise_verdict,
             "multichip_record": multichip_record,
+            "slo": slo,
             "platform": jax.devices()[0].platform,
         },
     }
@@ -3161,9 +3252,16 @@ def run_federation(
         {"h0": [f"h0:{k}" for k in range(shards_per_host)]}
     )
 
+    import shutil
+    import tempfile
+
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     repo = os.path.dirname(os.path.abspath(__file__))
     runner = os.path.join(repo, "examples", "federation_host.py")
+    # Each host process gets its own incident directory: the induced
+    # breach below must produce an exemplar-linked Perfetto dump ON THE
+    # OWNING HOST, and the parent asserts on it from the outside.
+    incident_root = tempfile.mkdtemp(prefix="bench-federation-incidents-")
     # Containers declared before the try so the finally can clean up
     # whatever a PARTIAL startup managed to spawn (a runner dying before
     # READY must not leak its siblings' processes or WAL flocks).
@@ -3229,6 +3327,7 @@ def run_federation(
         return round(sorted(rates)[1], 1)
 
     migration: "dict | None" = None
+    slo_detail: "dict | None" = None
     try:
         for host_id in host_ids:
             procs[host_id] = subprocess.Popen(
@@ -3240,7 +3339,12 @@ def run_federation(
                  "--voter-capacity", str(v_count + 2)],
                 stdin=subprocess.PIPE,
                 stdout=subprocess.PIPE,
-                env=env,
+                env=dict(
+                    env,
+                    HASHGRAPH_INCIDENT_DIR=os.path.join(
+                        incident_root, host_id
+                    ),
+                ),
                 cwd=repo,
             )
         for host_id, proc in procs.items():
@@ -3407,6 +3511,115 @@ def run_federation(
             zero_lost_votes=True,
             zero_lost_decisions=True,
         )
+
+        # ── SLO plane: merged federated scrape + induced breach ────────
+        # Healthy picture first: every decision so far was best-effort
+        # (no declared objective), so the per-host windowed quantiles
+        # describe the bench traffic and nothing alerts.
+        healthy_slo = driver_fed.merged_slo()
+        per_host_p99_ms = {
+            h: round(
+                (healthy_slo["hosts"].get(h, {}).get("global") or {})
+                .get("p99", 0.0) * 1_000.0, 3,
+            )
+            for h in host_ids
+        }
+        worst_p99_ms = max(per_host_p99_ms.values())
+        slo_objective_ms = 5_000.0
+        healthy_verdict = {
+            "objective_ms": slo_objective_ms,
+            "worst_host_p99_ms": worst_p99_ms,
+            "pass": bool(
+                not healthy_slo["alerts_firing"]
+                and worst_p99_ms <= slo_objective_ms
+            ),
+        }
+
+        # Induced breach: declare an impossible objective (1us) on a few
+        # fresh scopes, each ON ITS OWNING HOST, then decide them — every
+        # decide breaches, the multi-window burn rate saturates, and the
+        # owning host's SLO engine fires + dumps an incident linking the
+        # breaching decision's trace id.
+        breach_scopes = [f"slo-probe-{i}" for i in range(3)]
+        probe_signers = [
+            StubConsensusSigner(os.urandom(20)) for _ in range(v_count)
+        ]
+        for probe in breach_scopes:
+            owner_host, owner_shard = placement.owner(probe)
+            command(owner_host, f"SLOCFG {probe} 0.001")
+            pid, blob = clients[owner_host].create_proposal(
+                peer_ids[owner_host], probe, now, "slo", b"payload",
+                v_count, 3_600,
+            )
+            placement.pin(probe, owner_shard)
+            proposal = Proposal.decode(blob)
+            probe_votes: "list[bytes]" = []
+            for signer in probe_signers:
+                vote = build_vote(proposal, True, signer, now + 1)
+                proposal.votes.append(vote)
+                probe_votes.append(vote.encode())
+            for part in chunks(probe_votes):
+                driver_fed.submit(probe, part, now + 1)
+            driver_fed.pump()
+        probe_report = driver_fed.drain()
+        assert probe_report["acked"] == len(breach_scopes) * v_count, (
+            probe_report
+        )
+
+        merged_text = driver_fed.merged_metrics_text()
+        merged_slo = driver_fed.merged_slo()
+        hosts_labelled = all(
+            f'host="{h}"' in merged_text for h in host_ids
+        )
+        decision_histogram = (
+            "hashgraph_decision_latency_seconds_bucket" in merged_text
+        )
+        assert hosts_labelled, "merged scrape missing a host label"
+        assert decision_histogram, "merged scrape missing decide histogram"
+        firing = sorted(merged_slo["alerts_firing"])
+        for probe in breach_scopes:
+            assert any(a.endswith(f"/{probe}") for a in firing), (
+                probe, firing,
+            )
+
+        incidents = []
+        for host_id in host_ids:
+            host_dir = os.path.join(incident_root, host_id)
+            if not os.path.isdir(host_dir):
+                continue
+            for name in sorted(os.listdir(host_dir)):
+                inc_dir = os.path.join(host_dir, name)
+                with open(os.path.join(inc_dir, "incident.json")) as fh:
+                    meta = json.load(fh)
+                with open(os.path.join(inc_dir, "trace.json")) as fh:
+                    trace_doc = json.load(fh)
+                incidents.append({
+                    "host": host_id,
+                    "name": name,
+                    "reason": meta["reason"],
+                    "scope": meta["scope"],
+                    "trace_linked": bool(meta.get("trace_id")),
+                    "perfetto_loadable": "traceEvents" in trace_doc,
+                })
+        assert incidents, "induced breach produced no incident dump"
+        assert any(
+            i["perfetto_loadable"] and i["trace_linked"] for i in incidents
+        ), incidents
+
+        slo_detail = {
+            "windowed_per_host_p99_ms": per_host_p99_ms,
+            "windowed_decisions": healthy_slo["global"]["count"],
+            "verdict": healthy_verdict,
+            "merged_scrape": {
+                "hosts_labelled": hosts_labelled,
+                "decision_histogram": decision_histogram,
+            },
+            "induced_breach": {
+                "scopes": breach_scopes,
+                "alerts_firing": firing,
+                "incidents": incidents,
+            },
+        }
     finally:
         for driver in drivers:
             driver.close()
@@ -3418,6 +3631,7 @@ def run_federation(
                 proc.wait(timeout=15)
             except Exception:
                 proc.kill()
+        shutil.rmtree(incident_root, ignore_errors=True)
 
     med_fed = sorted(fed_rates)[len(fed_rates) // 2]
     med_single = sorted(single_rates)[len(single_rates) // 2]
@@ -3476,6 +3690,140 @@ def run_federation(
             "tally_path": "fabric",  # CPU backend: no cross-process psum
             "noise_verdict": noise_verdict,
             "migration": migration,
+            "slo": slo_detail,
+            "smoke": smoke,
+        },
+    }
+
+
+def run_slo_overhead(
+    p_count: int = 192,
+    v_count: int = 32,
+    reps: int = 5,
+    smoke: bool = False,
+) -> dict:
+    """Always-on SLO tracking cost: paired A/B of the same decision-heavy
+    workload with the process-global SloEngine enabled vs disabled.
+
+    Each rep runs one engine through ``p_count`` proposals x ``v_count``
+    voters to decision with ``decide_p99_ms`` declared on every scope —
+    the WORST case for the SLO plane, since every decide walks the full
+    observe path (windowed histogram + burn-rate evaluation + labelled
+    gauge upkeep). Arms interleave on-off-on-off in the same window so
+    drift hits both; the verdict asserts the median overhead stays under
+    the 5% acceptance bar, noise-aware (an overhead claim smaller than
+    the rep spread is reported but not failed on).
+
+    ``smoke`` (CI): tiny shapes, 3 paired reps.
+    """
+    from hashgraph_tpu import (
+        CreateProposalRequest,
+        ScopeConfigBuilder,
+        StubConsensusSigner,
+        build_vote,
+    )
+    from hashgraph_tpu.engine import TpuConsensusEngine
+    from hashgraph_tpu.obs import slo_engine
+
+    if smoke:
+        p_count, v_count, reps = 48, 16, 3
+    now = 1_700_000_000
+    total_votes = p_count * v_count
+    scope_cfg = ScopeConfigBuilder().with_decide_p99_ms(5_000.0).build()
+    signers = [StubConsensusSigner(bytes([k + 1]) * 20) for k in range(v_count)]
+    engine = TpuConsensusEngine(
+        StubConsensusSigner(b"\x09" * 20),
+        capacity=p_count + 8,
+        voter_capacity=v_count + 2,
+    )
+
+    slo_engine.reset()
+
+    def run_arm(tag: str) -> float:
+        # One proposal per scope, every scope carrying a declared
+        # objective: each rep is p_count decisions walking the full SLO
+        # observe path (all built untimed; only the ingest is timed).
+        batch: "list[tuple[str, object]]" = []
+        scopes = []
+        for p in range(p_count):
+            scope = f"{tag}-p{p}"
+            scopes.append(scope)
+            engine.set_scope_config(scope, scope_cfg)
+            request = CreateProposalRequest(
+                f"p{p}", b"payload", b"o", v_count, 3_600, True
+            )
+            pid = engine.create_proposal(scope, request, now).proposal_id
+            proposal = engine.get_proposal(scope, pid)
+            for signer in signers:
+                vote = build_vote(proposal, True, signer, now + 1)
+                proposal.votes.append(vote)
+                batch.append((scope, vote))
+            scopes[-1] = (scope, pid)
+        t0 = time.perf_counter()
+        engine.ingest_votes(batch, now + 1)
+        wall = time.perf_counter() - t0
+        for scope, pid in scopes:
+            assert engine.get_consensus_result(scope, pid) is True, scope
+        engine.delete_scopes([scope for scope, _pid in scopes])
+        return wall
+
+    # Untimed warmups compile at these shapes AND pre-install the
+    # per-scope labelled gauge families before either arm is timed.
+    # Scope names are FIXED per arm (reps recreate the same scopes), so
+    # the registry stays bounded and no timed rep pays a gauge install.
+    slo_engine.enabled = True
+    run_arm("on")
+    slo_engine.enabled = False
+    run_arm("off")
+
+    on_rates: list[float] = []
+    off_rates: list[float] = []
+    try:
+        for _rep in range(reps):
+            slo_engine.enabled = True
+            on_rates.append(total_votes / run_arm("on"))
+            slo_engine.enabled = False
+            off_rates.append(total_votes / run_arm("off"))
+    finally:
+        slo_engine.enabled = True  # never leave the plane off
+
+    med_on = sorted(on_rates)[len(on_rates) // 2]
+    med_off = sorted(off_rates)[len(off_rates) // 2]
+    overhead_pct = round(100.0 * (med_off - med_on) / med_off, 2)
+    max_spread = max(spread_pct(on_rates), spread_pct(off_rates))
+    # Noise-aware bar: an apparent overhead smaller than the rep-to-rep
+    # spread is indistinguishable from measurement noise, so it cannot
+    # fail the 5% ceiling on its own.
+    within_noise = bool(abs(overhead_pct) <= max_spread)
+    verdict = {
+        "pass": bool(overhead_pct < 5.0 or within_noise),
+        "criterion": (
+            "median SLO-on throughput within 5% of SLO-off, or the gap "
+            "is inside the rep spread (noise)"
+        ),
+        "overhead_pct": overhead_pct,
+        "within_noise": within_noise,
+        "spread_pct": {
+            "slo_on": spread_pct(on_rates),
+            "slo_off": spread_pct(off_rates),
+        },
+    }
+    state = slo_engine.state()
+    return {
+        "metric": "slo_tracking_overhead_pct",
+        "value": overhead_pct,
+        "unit": "%",
+        "detail": {
+            "proposals": p_count,
+            "votes_per_proposal": v_count,
+            "reps": reps,
+            "slo_on_votes_per_sec": [round(r, 1) for r in on_rates],
+            "slo_off_votes_per_sec": [round(r, 1) for r in off_rates],
+            "median_on": round(med_on, 1),
+            "median_off": round(med_off, 1),
+            "windowed_decisions_tracked": state["global"]["count"],
+            "alerts_firing": state["alerts_firing"],
+            "verdict": verdict,
             "smoke": smoke,
         },
     }
@@ -3754,6 +4102,8 @@ if __name__ == "__main__":
         "gossip": lambda: run_gossip(smoke=fleet_smoke, stages=gossip_stages),
         "chaos": lambda: run_chaos(smoke=fleet_smoke),
         "churn": lambda: run_churn(smoke=fleet_smoke),
+        "slo-overhead": lambda: run_slo_overhead(smoke=fleet_smoke),
+        "slo_overhead": lambda: run_slo_overhead(smoke=fleet_smoke),
         "default": run_default,
     }
     def _registry_snapshot() -> dict:

@@ -31,6 +31,13 @@ the parent closing the pipe is the shutdown signal:
         Drop the migrated shard + its temporary sync peer -> ``RETIRED``
     TALLY
         Local fleet state counts -> ``TALLY <json>``
+    SLOCFG <scope> <decide_p99_ms>
+        Declare a decide-latency SLO objective on a scope of this
+        host's fleet -> ``SLOCFG``  (the SLO engine starts alerting on
+        it; ``OP_METRICS_PULL`` / the merged ``/slo`` view report it)
+    SLOSET <0|1>
+        Toggle the process-wide SLO engine (the overhead-A/B kill
+        switch) -> ``SLOSET <0|1>``
 
 ``bench.py fleet --hosts N`` spawns one of these per host; it is also a
 handy way to run a real multi-process federation by hand.
@@ -130,6 +137,21 @@ def main() -> None:
                         + json.dumps({str(k): v for k, v in counts.items()}),
                         flush=True,
                     )
+                elif command == "SLOCFG":
+                    from hashgraph_tpu import ScopeConfigBuilder
+
+                    group.fleet.set_scope_config(
+                        rest[0],
+                        ScopeConfigBuilder()
+                        .with_decide_p99_ms(float(rest[1]))
+                        .build(),
+                    )
+                    print("SLOCFG", flush=True)
+                elif command == "SLOSET":
+                    from hashgraph_tpu.obs import slo_engine
+
+                    slo_engine.enabled = bool(int(rest[0]))
+                    print(f"SLOSET {int(slo_engine.enabled)}", flush=True)
                 else:
                     print(f"ERROR unknown command {command}", flush=True)
             except Exception as exc:  # one line per command, always

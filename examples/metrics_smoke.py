@@ -6,7 +6,13 @@ drives one proposal to decision over the wire, then asserts:
 - ``/metrics`` serves Prometheus text containing the well-known families
   (decision-latency histogram buckets, WAL fsync histogram, ingest batch
   size, bridge request counters);
+- EVERY family documented in the :mod:`hashgraph_tpu.obs` docstring
+  table is eagerly installed — a dashboard provisioned from the docs
+  must never see a hole before traffic arrives;
 - ``/healthz`` reports ok with the expected peer count;
+- ``/slo`` serves the machine-readable SLO state (windowed decision
+  quantiles, burn-rate alert list) and the decision driven above shows
+  up in its global window;
 - the ``GET_METRICS`` bridge opcode returns the same families over the
   wire protocol.
 
@@ -90,6 +96,14 @@ REQUIRED_FAMILIES = [
     "hashgraph_federation_remote_routed_votes_total",
     "hashgraph_federation_migrations_total",
     "hashgraph_federation_migration_seconds_bucket",
+    # SLO plane (hashgraph_tpu.obs.slo): breach/alert counters and the
+    # windowed burn-rate gauges exist from process start; the labelled
+    # per-scope/per-shard variants appear once objectives are declared.
+    "hashgraph_slo_breaches_total",
+    "hashgraph_slo_alerts_total",
+    "hashgraph_slo_alerts_firing",
+    "hashgraph_slo_burn_rate",
+    "hashgraph_slo_incidents_total",
 ]
 
 
@@ -123,6 +137,22 @@ def main() -> int:
                 missing = [f for f in REQUIRED_FAMILIES if f not in text]
                 assert not missing, f"missing families in /metrics: {missing}"
                 assert 'le="+Inf"' in text, "histogram missing +Inf bucket"
+
+                # The obs/__init__ docstring table IS the contract: every
+                # family it documents must be eagerly installed, so a
+                # dashboard provisioned from the docs sees no holes even
+                # before the matching subsystem carries traffic.
+                from hashgraph_tpu.obs import documented_families
+
+                documented = documented_families()
+                assert documented, "documented_families() came back empty"
+                undocumented_holes = [
+                    f for f in documented if f not in text
+                ]
+                assert not undocumented_holes, (
+                    f"documented families not eagerly installed: "
+                    f"{undocumented_holes}"
+                )
                 build_line = next(
                     l for l in text.splitlines()
                     if l.startswith("hashgraph_build_info{")
@@ -150,6 +180,18 @@ def main() -> int:
                 # (machine-readable degradation reasons appear there and
                 # in "reasons" when a critical rule fires).
                 assert "alerts" in health, health
+
+                # /slo: the machine-readable SLO plane. The decision we
+                # just drove must appear in the global fast window, and
+                # nothing alerts on a healthy smoke.
+                with urllib.request.urlopen(
+                    f"http://{mhost}:{mport}/slo", timeout=5
+                ) as response:
+                    slo = json.loads(response.read())
+                assert slo["enabled"] is True, slo
+                assert slo["global"]["count"] >= 1, slo["global"]
+                assert slo["alerts_firing"] == [], slo["alerts_firing"]
+                assert slo["burn_threshold"] > 0, slo
 
                 # Consensus-health snapshot over the wire (OP_HEALTH):
                 # both voters carry healthy scorecards.

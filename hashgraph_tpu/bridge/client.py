@@ -405,6 +405,15 @@ class BridgeClient:
         bridge wire."""
         return self._call(P.OP_GET_METRICS).blob().decode("utf-8")
 
+    def metrics_pull(self) -> dict:
+        """Raw metric-federation frame (``OP_METRICS_PULL``, server-wide):
+        ``{"host": <label>, "state": <mergeable registry state>, "slo":
+        <SLO engine state>}``. Unlike :meth:`get_metrics` this is the
+        UNRENDERED registry (non-cumulative histogram buckets, exemplars)
+        — the input ``parallel.rollup.merge_metric_states`` sums across
+        hosts into one fleet-wide scrape."""
+        return json.loads(self._call(P.OP_METRICS_PULL).blob().decode("utf-8"))
+
     def state_fingerprint(self, peer: int) -> str:
         """The peer engine's order-insensitive content digest
         (``OP_STATE_FINGERPRINT``; see ``sync.state_fingerprint``) — two

@@ -180,6 +180,15 @@ OP_SHM_ATTACH = 22
 # where it doesn't, a driver sums these frames across hosts.
 OP_FLEET_TALLY = 23
 
+# Federated metrics pull (server-wide, no peer_id — like GET_METRICS):
+# returns one JSON blob {"host": <label>, "state": <registry
+# export_state>, "slo": <SloEngine.state>}. GET_METRICS ships *rendered*
+# Prometheus text, which cannot be merged; this ships the raw mergeable
+# registry state (non-cumulative histogram buckets + exemplars) that
+# parallel.rollup.merge_metric_states sums into a single fleet-wide
+# /metrics + /slo view with per-host labels.
+OP_METRICS_PULL = 24
+
 # Opcodes that mutate server-side state (plus POLL_EVENTS, whose read is
 # DESTRUCTIVE — it drains the peer's event queue). On a pipelined
 # connection the server executes these in receive order per connection;

@@ -46,6 +46,7 @@ from ..obs import (
     flight_recorder,
 )
 from ..obs import registry as default_registry
+from ..obs import slo_engine as default_slo_engine
 from ..obs.trace import trace_store, use_context
 from ..parallel.fleet import ShardRecoveringError
 from ..signing import ConsensusSignatureScheme
@@ -220,9 +221,14 @@ class BridgeServer:
         pipeline_workers: int | None = None,
         max_inflight_per_connection: int = 256,
         wire_columnar: "bool | None" = None,
+        host_label: str | None = None,
     ):
         self._host = host
         self._port = port
+        # Identity stamped on OP_METRICS_PULL frames: federation merges
+        # per-host registry states under this label (default: the bound
+        # host:port once the listener is up).
+        self.host_label = host_label
         self._capacity = capacity
         self._voter_capacity = voter_capacity
         self._engine_factory = engine_factory
@@ -935,6 +941,23 @@ class BridgeServer:
             return P.STATUS_OK, P.blob(
                 default_registry.render_prometheus().encode("utf-8")
             )
+        if opcode == P.OP_METRICS_PULL:
+            # Server-wide raw metric federation frame: the mergeable
+            # registry state + SLO state under this host's label — what a
+            # federation driver sums (parallel.rollup.merge_metric_states)
+            # into one fleet /metrics + /slo view.
+            label = self.host_label
+            if label is None:
+                try:
+                    label = "%s:%d" % (self._host, self.address[1])
+                except Exception:
+                    label = self._host
+            payload = {
+                "host": label,
+                "state": default_registry.export_state(),
+                "slo": default_slo_engine.state(),
+            }
+            return P.STATUS_OK, P.blob(json.dumps(payload).encode("utf-8"))
         if opcode == P.OP_VOTE_BATCH:
             # Multi-peer frame: groups carry their own peer ids.
             return self._op_vote_batch(c, vote_prep)

@@ -64,6 +64,7 @@ from ..obs import (
     TimelineStore,
     flight_recorder,
     observed_span,
+    slo_engine,
 )
 from ..obs.prometheus import _escape_label
 from ..obs import health_monitor as default_health_monitor
@@ -498,6 +499,14 @@ class TpuConsensusEngine(Generic[Scope]):
         self._timelines = TimelineStore(
             self.metrics.histogram(DECISION_LATENCY)
         )
+        # SLO plane: every observed decision latency also lands in the
+        # process SLO engine's sliding windows, carrying the scope's
+        # declared objective (ScopeConfig.decide_p99_ms) and the bound
+        # trace id so a breach's incident dump can link the causal trace.
+        # The shard label is stamped by the fleet router at shard build
+        # time; a standalone engine reports unlabelled.
+        self._slo_shard: str | None = None
+        self._timelines.slo_sink = self._slo_observe
         # Engine-state gauges sampled at scrape time, weakly bound: a
         # collected engine's contribution vanishes instead of freezing.
         ref = weakref.ref(self)
@@ -689,6 +698,9 @@ class TpuConsensusEngine(Generic[Scope]):
         parent = current_context()
         ctx = parent.child() if parent is not None else TraceContext.generate()
         record.trace = ctx
+        tl = self._timelines.get(record.slot)
+        if tl is not None and tl.proposal_id == record.proposal.proposal_id:
+            tl.trace_hex = ctx.trace_id.hex()
         trace_store.record(
             span_name,
             ctx,
@@ -700,6 +712,24 @@ class TpuConsensusEngine(Generic[Scope]):
                 "scope": str(scope),
                 "proposal_id": record.proposal.proposal_id,
             },
+        )
+
+    def _slo_observe(self, tl, latency: float) -> None:
+        """TimelineStore slo_sink: one call per observed decision (same
+        gating as the latency histogram). Resolves the scope's declared
+        objective and forwards to the process SLO engine — a single
+        short-lock windowed-sketch update, cheap enough to stay always-on
+        (the <5% bound is held by bench.py's slo-overhead A/B)."""
+        cfg = self._scope_configs.get(tl.scope)
+        objective = None
+        if cfg is not None and cfg.decide_p99_ms is not None:
+            objective = cfg.decide_p99_ms * 1e-3
+        slo_engine.observe(
+            tl.scope,
+            latency,
+            shard=self._slo_shard,
+            objective_s=objective,
+            trace_hex=tl.trace_hex,
         )
 
     def _ensure_unique_pid(

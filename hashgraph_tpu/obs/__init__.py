@@ -36,11 +36,11 @@ wal_fsync_seconds                               histogram  WAL writer (per fsync
 wal_recover_seconds                             histogram  DurableEngine.recover
 hashgraph_live_proposals                        gauge      engines (tracked sessions)
 hashgraph_vote_table_occupancy                  gauge      engines (claimed pool slots)
-hashgraph_tier_demoted_sessions / _tier_bytes   gauge      engines (demoted-tier population / bytes)
+hashgraph_tier_{demoted_sessions,bytes}         gauge      engines (demoted-tier population / bytes)
 hashgraph_tier_{demotions,promotions,gc}_total  counter    engine tier lifecycle traffic
 wal_segment_count / wal_segment_bytes           gauge      WAL writers (live log footprint)
 hashgraph_chain_suffix_length                   histogram  engine (votes applied per watermark extension)
-hashgraph_votes_total / _accepted_total         counter    engine ingest paths
+hashgraph_votes_{total,accepted_total}          counter    engine ingest paths
 hashgraph_proposals_created_total               counter    engine registration
 hashgraph_decisions_total                       counter    engine transitions
 hashgraph_timeouts_fired_total                  counter    engine timeout paths
@@ -58,10 +58,10 @@ hashgraph_equivocations_total                   counter    health evidence log (
 hashgraph_fork_redeliveries_total               counter    health evidence log (watermark forks)
 hashgraph_truncation_redeliveries_total         counter    health scorecards (lagging chains)
 hashgraph_expired_gossip_total                  counter    health scorecards (stale redeliveries)
-hashgraph_tracked_peers / _evidence_records     gauge      default health monitor
+hashgraph_{tracked_peers,evidence_records}      gauge      default health monitor
 hashgraph_stale_peers                           gauge      liveness watchdog
 hashgraph_jax_live_buffer_bytes                 gauge      live JAX array bytes (scrape-time)
-hashgraph_jax_compile_cache_{hits,misses}_total counter    persistent XLA compile cache
+hashgraph_jax_compile_cache_{hits,misses}_total  counter   persistent XLA compile cache
 hashgraph_sync_chunks_sent_total                counter    bridge sync source (snapshot chunks served)
 hashgraph_sync_chunks_received_total            counter    CatchUpClient (snapshot chunks verified)
 hashgraph_sync_tail_records_total               counter    CatchUpClient (WAL tail records applied)
@@ -74,13 +74,26 @@ hashgraph_gossip_inflight_requests              gauge      gossip transport unan
 hashgraph_gossip_anti_entropy_rounds_total      counter    GossipNode anti-entropy rounds
 hashgraph_gossip_anti_entropy_sessions_total    counter    GossipNode sessions pushed by anti-entropy
 hashgraph_gossip_catchup_escalations_total      counter    GossipNode escalations to CatchUpClient
+hashgraph_slo_breaches_total                    counter    SLO engine (decisions over their scope objective)
+hashgraph_slo_alerts_total                      counter    SLO engine (burn-rate alert rising edges)
+hashgraph_slo_alerts_firing                     gauge      SLO engine (objectives currently alerting)
+hashgraph_slo_decision_p99_seconds (+ {scope=...}/{shard=...})  gauge  SLO engine (fast-window p99)
+hashgraph_slo_burn_rate (+ {scope=...,window=...})  gauge   SLO engine (max fast-window burn rate)
+hashgraph_slo_incidents_total                   counter    incident capture (dumps written)
 ==============================================  =========  ==================
+
+The table above is machine-readable: :func:`documented_families` parses it
+(brace expansion, ``/`` alternatives, ``(+ ...)`` labelled-variant notes
+stripped) and ``examples/metrics_smoke.py`` asserts every listed family is
+eagerly installed — documentation drift from the registry is a test
+failure, not a silent lie.
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
+import re
 import time
 
 from .flight import FlightRecorder, flight_recorder
@@ -109,6 +122,17 @@ from .registry import (
     Info,
     MetricsRegistry,
     log_buckets,
+)
+from .slo import (
+    SLO_ALERTS_FIRING,
+    SLO_ALERTS_TOTAL,
+    SLO_BREACHES_TOTAL,
+    SLO_BURN_RATE,
+    SLO_DECISION_P99_SECONDS,
+    SLO_INCIDENTS_TOTAL,
+    IncidentCapture,
+    SloEngine,
+    WindowedHistogram,
 )
 from .timeline import ProposalTimeline, TimelineStore
 from .trace import (
@@ -326,8 +350,16 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         WIRE_CRYPTO_SECONDS_TOTAL,
         WIRE_APPLY_SECONDS_TOTAL,
         SHM_RINGS_ATTACHED_TOTAL,
+        SLO_BREACHES_TOTAL,
+        SLO_ALERTS_TOTAL,
+        SLO_INCIDENTS_TOTAL,
     ):
         reg.counter(name)
+    # SLO gauges with registered providers come from the SloEngine bound
+    # to this registry (below, for the default); bare families still must
+    # exist from process start so an idle scrape sees them.
+    for name in (SLO_ALERTS_FIRING, SLO_DECISION_P99_SECONDS, SLO_BURN_RATE):
+        reg.gauge(name)
     reg.info(BUILD_INFO).set(
         # Resolved at scrape time: the package version needs the top-level
         # package object (circular at obs import time), and naming the JAX
@@ -388,6 +420,46 @@ def _jax_backend() -> str:
 
 _install_well_known(registry)
 flight_recorder.dump_counter = registry.counter(FLIGHT_DUMPS_TOTAL)
+
+# Process-wide SLO engine (mirrors ``registry``'s role): engines feed it
+# one observation per decision via their timeline sink; its windowed
+# quantile / burn-rate / alert state backs the ``hashgraph_slo_*``
+# families above and the sidecar's ``/slo`` endpoint. Incident capture is
+# armed by ``$HASHGRAPH_INCIDENT_DIR`` (unset = evidence capture off).
+slo_engine = SloEngine(
+    registry,
+    capture=IncidentCapture(counter=registry.counter(SLO_INCIDENTS_TOTAL)),
+)
+
+
+def documented_families() -> list[str]:
+    """Family names parsed from this module's docstring table — the
+    contract ``examples/metrics_smoke.py`` holds the registry to, so the
+    table can never silently drift from what is actually installed.
+    Handles ``prefix{a,b}suffix`` brace alternatives, ``a / b`` listings,
+    and strips ``(+ ...)`` labelled-variant notes."""
+    table = __doc__.split("Well-known families", 1)[1]
+    names: set[str] = set()
+    separators = 0
+    for line in table.splitlines():
+        if line.startswith("====="):
+            separators += 1
+            if separators >= 3:
+                break
+            continue
+        if separators != 2 or not line.strip():
+            continue
+        cell = re.split(r"\s{2,}", line.strip())[0]
+        cell = cell.split(" (+", 1)[0].strip()
+        for part in cell.split(" / "):
+            part = part.strip()
+            m = re.match(r"^([\w:]*)\{([\w,]+)\}([\w:]*)$", part)
+            if m:
+                for alt in m.group(2).split(","):
+                    names.add(m.group(1) + alt + m.group(3))
+            elif part:
+                names.add(part)
+    return sorted(names)
 
 # Process-wide default health monitor (mirrors ``registry``'s role):
 # engines not given their own share this one, so a bridge server's
@@ -515,17 +587,21 @@ __all__ = [
     "GaugeHandle",
     "HealthMonitor",
     "Histogram",
+    "IncidentCapture",
     "Info",
     "MetricsRegistry",
     "MetricsSidecar",
     "PeerScorecard",
     "ProposalTimeline",
+    "SloEngine",
     "TimelineStore",
     "TraceContext",
     "TraceSpan",
     "TraceStore",
+    "WindowedHistogram",
     "attach_trace",
     "current_context",
+    "documented_families",
     "extract_trace",
     "flight_recorder",
     "health_monitor",
@@ -534,6 +610,7 @@ __all__ = [
     "merge_traces",
     "observed_span",
     "registry",
+    "slo_engine",
     "trace_store",
     "use_context",
 ]

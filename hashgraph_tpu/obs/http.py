@@ -1,5 +1,6 @@
-"""Stdlib-threaded HTTP sidecar: ``/metrics`` (Prometheus text format) and
-``/healthz`` (JSON liveness) without any dependency beyond ``http.server``.
+"""Stdlib-threaded HTTP sidecar: ``/metrics`` (Prometheus text format),
+``/healthz`` (JSON liveness), and ``/slo`` (machine-readable SLO /
+burn-rate alert state) without any dependency beyond ``http.server``.
 
 The sidecar is deliberately tiny: scrapes are infrequent (seconds apart)
 and the render is a single registry walk, so a ThreadingHTTPServer on a
@@ -20,7 +21,13 @@ from .prometheus import CONTENT_TYPE
 class MetricsSidecar:
     """Serve one registry over HTTP. ``health_fn`` (optional) returns the
     JSON body for ``/healthz``; a falsy ``"ok"`` key turns the status into
-    503 so load balancers can act on it."""
+    503 so load balancers can act on it. ``slo_fn`` (optional) returns the
+    JSON body for ``/slo`` — by default the process-wide
+    :meth:`~hashgraph_tpu.obs.slo.SloEngine.state`; pass a merged-view
+    callable (federation) to serve fleet-wide SLO state instead.
+    ``render_fn`` (optional) overrides the ``/metrics`` text entirely —
+    the federation's merged-scrape hook (one scrape, every host's
+    families labelled ``host="..."`` plus fleet totals)."""
 
     def __init__(
         self,
@@ -28,11 +35,23 @@ class MetricsSidecar:
         host: str = "127.0.0.1",
         port: int = 0,
         health_fn=None,
+        slo_fn=None,
+        render_fn=None,
     ):
         self._registry = registry
         self._host = host
         self._port = port
         self._health_fn = health_fn
+        self._render_fn = render_fn
+        if slo_fn is None:
+            # Late import: obs/__init__ constructs the default SloEngine
+            # after importing this module.
+            def slo_fn():
+                from . import slo_engine
+
+                return slo_engine.state()
+
+        self._slo_fn = slo_fn
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -45,12 +64,33 @@ class MetricsSidecar:
     def start(self) -> tuple[str, int]:
         registry = self._registry
         health_fn = self._health_fn
+        slo_fn = self._slo_fn
+        render_fn = self._render_fn
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib naming)
                 if self.path.split("?", 1)[0] == "/metrics":
-                    body = registry.render_prometheus().encode("utf-8")
-                    self._reply(200, CONTENT_TYPE, body)
+                    if render_fn is not None:
+                        try:
+                            text = render_fn()
+                        except Exception as exc:
+                            self._reply(
+                                503, "text/plain", repr(exc).encode() + b"\n"
+                            )
+                            return
+                    else:
+                        text = registry.render_prometheus()
+                    self._reply(200, CONTENT_TYPE, text.encode("utf-8"))
+                elif self.path.split("?", 1)[0] == "/slo":
+                    try:
+                        payload = slo_fn()
+                    except Exception as exc:
+                        payload = {"error": repr(exc)}
+                    self._reply(
+                        200,
+                        "application/json",
+                        json.dumps(payload).encode("utf-8"),
+                    )
                 elif self.path.split("?", 1)[0] == "/healthz":
                     payload = {"ok": True}
                     if health_fn is not None:

@@ -27,8 +27,38 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 import weakref
 from bisect import bisect_left
+
+
+def quantile_from(
+    bounds: tuple[float, ...], counts: list[int], total: int, q: float
+) -> float:
+    """Estimate the q-quantile (0 < q < 1) of a log-bucketed count vector
+    by log-linear interpolation within the containing bucket. 0.0 when
+    empty; the last finite bound when the quantile falls in the +Inf
+    bucket. Shared by :class:`Histogram` and the SLO engine's windowed
+    sketches (:mod:`hashgraph_tpu.obs.slo`), which reuse these buckets."""
+    if total == 0:
+        return 0.0
+    rank = q * total
+    running = 0.0
+    for i, n in enumerate(counts):
+        if n == 0:
+            continue
+        if running + n >= rank:
+            if i >= len(bounds):
+                return bounds[-1]
+            hi = bounds[i]
+            lo = bounds[i - 1] if i > 0 else hi / 2.0
+            frac = (rank - running) / n
+            # Interpolate in log space — the buckets are log-spaced.
+            return math.exp(
+                math.log(lo) + frac * (math.log(hi) - math.log(lo))
+            )
+        running += n
+    return bounds[-1]
 
 
 def log_buckets(lo: float, hi: float, factor: float = 2.0) -> tuple[float, ...]:
@@ -173,9 +203,16 @@ DEFAULT_SIZE_BUCKETS = log_buckets(1.0, 32 * 1024 * 1024)  # counts/bytes
 
 class Histogram:
     """Fixed log-bucketed histogram. ``observe`` is one bisect + two adds
-    under the instrument lock; there is no per-observation allocation."""
+    under the instrument lock; there is no per-observation allocation.
 
-    __slots__ = ("name", "bounds", "_counts", "_sum", "_count", "_lock")
+    An observation may carry an OpenMetrics-style *exemplar* — a trace id
+    correlating that one sample with its distributed trace. One exemplar
+    is kept per bucket (latest wins), so a scrape can always link each
+    latency band to a concrete causal trace; storage stays bounded at one
+    small tuple per bucket, allocated lazily on the first exemplar."""
+
+    __slots__ = ("name", "bounds", "_counts", "_sum", "_count", "_lock",
+                 "_exemplars")
 
     def __init__(self, name: str, bounds: tuple[float, ...] = DEFAULT_TIME_BUCKETS):
         if not bounds or any(
@@ -188,13 +225,24 @@ class Histogram:
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
+        self._exemplars: dict[int, tuple[float, str, float]] | None = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: "str | None" = None) -> None:
         idx = bisect_left(self.bounds, value)
         with self._lock:
             self._counts[idx] += 1
             self._sum += value
             self._count += 1
+            if exemplar is not None:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                self._exemplars[idx] = (float(value), exemplar, time.time())
+
+    def exemplars(self) -> dict[int, tuple[float, str, float]]:
+        """Per-bucket-index {idx: (value, trace_id, unix_ts)} — the latest
+        exemplar observed into each bucket (empty until one is recorded)."""
+        with self._lock:
+            return dict(self._exemplars) if self._exemplars else {}
 
     @property
     def count(self) -> int:
@@ -234,25 +282,7 @@ class Histogram:
         return self._quantile_from(counts, total, q)
 
     def _quantile_from(self, counts: list[int], total: int, q: float) -> float:
-        if total == 0:
-            return 0.0
-        rank = q * total
-        running = 0.0
-        for i, n in enumerate(counts):
-            if n == 0:
-                continue
-            if running + n >= rank:
-                if i >= len(self.bounds):
-                    return self.bounds[-1]
-                hi = self.bounds[i]
-                lo = self.bounds[i - 1] if i > 0 else hi / 2.0
-                frac = (rank - running) / n
-                # Interpolate in log space — the buckets are log-spaced.
-                return math.exp(
-                    math.log(lo) + frac * (math.log(hi) - math.log(lo))
-                )
-            running += n
-        return self.bounds[-1]
+        return quantile_from(self.bounds, counts, total, q)
 
     def snapshot(self) -> dict:
         # ONE locked copy: count/sum and every quantile must describe the
@@ -266,6 +296,23 @@ class Histogram:
             "p50": self._quantile_from(counts, total, 0.5),
             "p90": self._quantile_from(counts, total, 0.9),
             "p99": self._quantile_from(counts, total, 0.99),
+        }
+
+    def export_state(self) -> dict:
+        """Raw mergeable state (NON-cumulative per-bucket counts, bounds,
+        sum, count, exemplars keyed by bucket index as strings) — the
+        JSON-able shape ``OP_METRICS_PULL`` ships and
+        ``parallel.rollup.merge_metric_states`` sums across hosts."""
+        with self._lock:
+            counts = list(self._counts)
+            s, total = self._sum, self._count
+            ex = dict(self._exemplars) if self._exemplars else {}
+        return {
+            "bounds": list(self.bounds),
+            "counts": counts,
+            "sum": s,
+            "count": total,
+            "exemplars": {str(i): list(v) for i, v in ex.items()},
         }
 
 
@@ -346,6 +393,26 @@ class MetricsRegistry:
             "counters": {c.name: c.value for c in counters},
             "gauges": {g.name: g.value for g in gauges},
             "histograms": {h.name: h.snapshot() for h in histograms},
+            "infos": {i.name: i.labels() for i in infos},
+        }
+
+    def export_state(self) -> dict:
+        """One JSON-able frame of the whole registry: counter values,
+        sampled gauge values, raw (mergeable) histogram buckets with
+        exemplars, resolved info labels. This is what the bridge's
+        ``OP_METRICS_PULL`` ships and what
+        ``parallel.rollup.merge_metric_states`` merges into a fleet-wide
+        view — unlike :meth:`snapshot`, nothing is pre-aggregated into
+        quantiles, so sums across hosts stay exact."""
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            histograms = list(self._histograms.values())
+            infos = list(self._infos.values())
+        return {
+            "counters": {c.name: c.value for c in counters},
+            "gauges": {g.name: g.value for g in gauges},
+            "histograms": {h.name: h.export_state() for h in histograms},
             "infos": {i.name: i.labels() for i in infos},
         }
 

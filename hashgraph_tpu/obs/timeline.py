@@ -43,6 +43,11 @@ class ProposalTimeline:
     # vote-carrying gossip): the wall stamps then measure load time, not a
     # decision this engine made, so no latency is derived or observed.
     pre_decided: bool = False
+    # Hex trace id of the session's bound distributed-trace context
+    # (stamped by the engine's _bind_trace when tracing is on): the
+    # decision-latency observation carries it as an OpenMetrics exemplar,
+    # and an SLO breach's incident dump filters trace_store to it.
+    trace_hex: str | None = None
 
     def as_dict(self) -> dict:
         """Readout shape for embedders and the bridge: raw stamps plus the
@@ -74,6 +79,13 @@ class TimelineStore:
 
     def __init__(self, decision_histogram, completed_capacity: int = 1024):
         self._hist = decision_histogram
+        # Optional SLO hook: called as slo_sink(timeline, latency_s) for
+        # every latency this store observes (same gating as the histogram
+        # — never for pre_decided/replay/unowned sessions). The engine
+        # points this at the process SLO engine; keeping it a plain
+        # callable keeps this module free of policy and lets the ~7
+        # engine decided() call sites stay untouched.
+        self.slo_sink = None
         self._live: dict[int, ProposalTimeline] = {}
         self._done: deque[ProposalTimeline] = deque()
         self._done_capacity = completed_capacity
@@ -146,7 +158,10 @@ class TimelineStore:
         if pre_decided:
             tl.pre_decided = True
         elif observe:
-            self._hist.observe(wall - tl.created_wall)
+            latency = wall - tl.created_wall
+            self._hist.observe(latency, exemplar=tl.trace_hex)
+            if self.slo_sink is not None:
+                self.slo_sink(tl, latency)
 
     def forget(self, slot: int) -> None:
         tl = self._live.pop(slot, None)

@@ -59,9 +59,14 @@ from ..obs import (
     FEDERATION_MIGRATIONS_TOTAL,
     FEDERATION_REMOTE_ROUTED_VOTES_TOTAL,
     flight_recorder,
+    slo_engine,
 )
 from ..obs import registry as default_registry
-from .rollup import aggregate_occupancy
+from .rollup import (
+    aggregate_occupancy,
+    merge_metric_states,
+    merge_slo_states,
+)
 from .fleet import (
     ConsensusFleet,
     ShardMigratingError,
@@ -655,6 +660,7 @@ class FleetGroup:
         self.peer_id = 0
         self._transport = None
         self._remote: "dict[str, _RemoteHost]" = {}
+        self._merged_sidecar = None
         self._lock = threading.Lock()
         ref_self = weakref.ref(self)
         default_registry.register_gauge(
@@ -682,6 +688,7 @@ class FleetGroup:
             engine_factory=self._pop_engine,
             signer_factory=StubConsensusSigner,
             wire_columnar=self._wire_columnar,
+            host_label=self.host_id,
         )
         self.server.start()
         self.peer_id = self._register(self.adapter)
@@ -728,6 +735,9 @@ class FleetGroup:
             self._remote[host_id] = _RemoteHost(host_id, host, port, peer_id)
 
     def close(self) -> None:
+        if self._merged_sidecar is not None:
+            self._merged_sidecar.stop()
+            self._merged_sidecar = None
         if self._transport is not None:
             self._transport.close()
         if self.server is not None:
@@ -923,6 +933,77 @@ class FleetGroup:
         from ..sync.snapshot import state_fingerprint
 
         return state_fingerprint(self.adapter)
+
+    # ── metric federation (OP_METRICS_PULL frames + merged views) ──────
+
+    def metrics_frame(self) -> dict:
+        """This host's ``OP_METRICS_PULL`` frame, locally (no wire hop):
+        the raw registry state + SLO state under the host's label — the
+        same dict a remote puller would receive."""
+        return {
+            "host": self.host_id,
+            "state": default_registry.export_state(),
+            "slo": slo_engine.state(),
+        }
+
+    def federated_metric_frames(self) -> "list[dict]":
+        """The local frame plus every connected host's, pulled over the
+        fabric as single ``OP_METRICS_PULL`` frames."""
+        import json
+
+        from ..bridge import protocol as P
+
+        with self._lock:
+            remote = list(self._remote.values())
+        futures = [
+            self._transport.request(info.host_id, P.OP_METRICS_PULL, b"")
+            for info in remote
+        ]
+        frames = [self.metrics_frame()]
+        for future in futures:
+            frames.append(
+                json.loads(
+                    future.result(self._request_timeout)
+                    .blob()
+                    .decode("utf-8")
+                )
+            )
+        return frames
+
+    def federated_metrics(self) -> dict:
+        """Fleet-wide registry state: per-host labelled families + bare
+        fleet totals, through the ONE shared merge
+        (:func:`~hashgraph_tpu.parallel.rollup.merge_metric_states`)."""
+        return merge_metric_states(self.federated_metric_frames())
+
+    def federated_metrics_text(self) -> str:
+        """The merged frames rendered in Prometheus text format — the
+        body a fleet-wide ``/metrics`` scrape serves."""
+        from ..obs.prometheus import render_state
+
+        return render_state(self.federated_metrics())
+
+    def federated_slo(self) -> dict:
+        """Fleet-wide ``/slo`` view: per-host SLO states plus firing
+        alerts/incidents qualified ``host/...``."""
+        return merge_slo_states(self.federated_metric_frames())
+
+    def serve_merged_metrics(
+        self, host: str = "127.0.0.1", port: int = 0
+    ) -> "tuple[str, int]":
+        """Start a sidecar whose ``/metrics`` and ``/slo`` serve the
+        MERGED fleet view (pulling every connected host per scrape).
+        Returns the bound address; stopped by :meth:`close`."""
+        from ..obs.http import MetricsSidecar
+
+        self._merged_sidecar = MetricsSidecar(
+            default_registry,
+            host=host,
+            port=port,
+            render_fn=self.federated_metrics_text,
+            slo_fn=self.federated_slo,
+        )
+        return self._merged_sidecar.start()
 
     # ── migration (source + destination halves) ────────────────────────
 
@@ -1345,6 +1426,36 @@ class FederationDriver:
             host_id, P.OP_STATE_FINGERPRINT, P.u32(info.peer_id)
         )
         return future.result(self._timeout).string()
+
+    def pull_metric_frames(self) -> "list[dict]":
+        """One ``OP_METRICS_PULL`` frame per connected host (the driver
+        has no local fleet, so every frame comes over the fabric)."""
+        import json
+
+        from ..bridge import protocol as P
+
+        with self._lock:
+            hosts = list(self._hosts)
+        futures = [
+            self._transport.request(host, P.OP_METRICS_PULL, b"")
+            for host in hosts
+        ]
+        return [
+            json.loads(f.result(self._timeout).blob().decode("utf-8"))
+            for f in futures
+        ]
+
+    def merged_metrics(self) -> dict:
+        """Fleet-wide registry state through the ONE shared merge."""
+        return merge_metric_states(self.pull_metric_frames())
+
+    def merged_metrics_text(self) -> str:
+        from ..obs.prometheus import render_state
+
+        return render_state(self.merged_metrics())
+
+    def merged_slo(self) -> dict:
+        return merge_slo_states(self.pull_metric_frames())
 
     # ── migration window (the driver's half of a live migration) ───────
 

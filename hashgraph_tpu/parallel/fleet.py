@@ -410,6 +410,10 @@ class ConsensusFleet:
             verify_cache=self._verify_cache,
             health_monitor=HealthMonitor(),
         )
+        # SLO plane: decisions this shard's engine makes land in the
+        # process SLO engine's per-shard sliding windows under this label
+        # (hashgraph_slo_decision_p99_seconds{shard="..."}).
+        engine._slo_shard = shard_id
         wal_dir = None
         if self._wal_root is not None:
             from ..wal import DurableEngine

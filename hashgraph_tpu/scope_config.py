@@ -37,7 +37,15 @@ class ScopeConfig:
     back transparently on any touch), and ``evict_decided_after`` seconds
     after a session's deciding activity garbage-collect decided/failed
     sessions outright. Both default to None = never (reference
-    behavior)."""
+    behavior).
+
+    ``decide_p99_ms`` is the scope's declarative latency SLO (also
+    embedder-layer, no reference analogue): the p99 decision-latency
+    objective in milliseconds. Decisions slower than this count against
+    the scope's error budget in the SLO engine
+    (:mod:`hashgraph_tpu.obs.slo`) — sustained breaching fires a
+    multi-window burn-rate alert and an incident dump. None (the
+    default) = best-effort scope, tracked but never alerting."""
 
     network_type: NetworkType = NetworkType.GOSSIPSUB
     default_consensus_threshold: float = 2.0 / 3.0
@@ -46,6 +54,7 @@ class ScopeConfig:
     max_rounds_override: int | None = None
     demote_after: float | None = None
     evict_decided_after: float | None = None
+    decide_p99_ms: float | None = None
 
     def validate(self) -> None:
         """reference: src/scope_config.rs:57-69 — Some(0) override is only
@@ -64,6 +73,10 @@ class ScopeConfig:
         for ttl in (self.demote_after, self.evict_decided_after):
             if ttl is not None and not ttl > 0:
                 raise ValueError("tier TTLs must be positive seconds (or None)")
+        if self.decide_p99_ms is not None and not self.decide_p99_ms > 0:
+            raise ValueError(
+                "decide_p99_ms must be positive milliseconds (or None)"
+            )
 
     def clone(self) -> "ScopeConfig":
         return ScopeConfig(
@@ -74,6 +87,7 @@ class ScopeConfig:
             max_rounds_override=self.max_rounds_override,
             demote_after=self.demote_after,
             evict_decided_after=self.evict_decided_after,
+            decide_p99_ms=self.decide_p99_ms,
         )
 
     @classmethod
@@ -125,6 +139,12 @@ class ScopeConfigBuilder:
         """Decided/failed sessions are garbage-collected outright this
         many seconds after their deciding activity (None = never)."""
         self._config.evict_decided_after = seconds
+        return self
+
+    def with_decide_p99_ms(self, ms: float | None) -> "ScopeConfigBuilder":
+        """Declare the scope's p99 decision-latency SLO in milliseconds
+        (None = best-effort; tracked in the SLO engine, never alerting)."""
+        self._config.decide_p99_ms = ms
         return self
 
     def p2p_preset(self) -> "ScopeConfigBuilder":

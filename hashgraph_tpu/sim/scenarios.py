@@ -26,6 +26,8 @@ The corpus (≥ the ISSUE's eight):
 - ``timeout-liveness``      — embedder timeouts decide identically everywhere
 - ``tiered-crash-recovery`` — kill-9 with demoted sessions (WAL recovery) +
   lost-disk catch-up from tiered sources, fingerprint equality throughout
+- ``slo-burn``              — hot-shard overload against a declared decide
+  objective: burn-rate alert fires, clears on heal, ONE incident dump
 
 A corpus run can also prove the harness is not blind to itself:
 ``blind=True`` disables the health/evidence layer (the deliberately
@@ -485,6 +487,111 @@ def _timeout_liveness(c: SimCluster):
     }}
 
 
+def _slo_burn(c: SimCluster):
+    """Deterministic hot-shard overload against a declared decide-latency
+    objective: a private :class:`~hashgraph_tpu.obs.slo.SloEngine` rides
+    the cluster's VIRTUAL clock (ticks as seconds — no wall time, so the
+    alert trajectory is a pure function of the seed) while real consensus
+    traffic supplies the trace ids. Healthy baseline -> injected slowdown
+    (every decision breaches) -> the multi-window burn-rate alert MUST
+    fire; heal -> the fast window recovers and the alert MUST clear; and
+    the breach storm collapses into exactly ONE incident dump whose
+    ``incident.json`` links the breaching decision's trace id."""
+    import json as _json
+    import os
+
+    from ..obs.slo import IncidentCapture, SloEngine
+
+    clock = lambda: float(c.now)  # noqa: E731 — the cluster's virtual clock
+    incident_root = os.path.join(c.root, "incidents")
+    slo = SloEngine(
+        clock=clock,
+        capture=IncidentCapture(
+            incident_root, cooldown_s=10**9, clock=clock
+        ),
+    )
+    hot_scope = "chaos/hot"
+    objective_s = 0.05  # a 50ms decide p99 objective on the hot scope
+
+    def decide(tag: str) -> "str | None":
+        session = c.create_session(c.peer(0), tag)
+        c.vote_all(session)
+        ctx = session.origin.engine.trace_context_of(
+            session.scope, session.pid
+        )
+        return ctx.trace_id.hex() if ctx is not None else None
+
+    # Phase 1 — healthy baseline: 30 decisions at 5ms over 900 virtual
+    # seconds fill the slow window with in-objective traffic.
+    for k in range(30):
+        trace = decide(f"warm-{k}")
+        slo.observe(
+            hot_scope, 0.005, shard="hot", objective_s=objective_s,
+            trace_hex=trace, now=clock(),
+        )
+        c.advance_clock(30)
+
+    # Phase 2 — overload: every decision takes 500ms (10x the
+    # objective). Both burn windows must cross the threshold.
+    breach_trace = None
+    for k in range(10):
+        trace = decide(f"slow-{k}")
+        if breach_trace is None:
+            breach_trace = trace
+        slo.observe(
+            hot_scope, 0.5, shard="hot", objective_s=objective_s,
+            trace_hex=trace, now=clock(),
+        )
+        c.advance_clock(10)
+    overload_state = slo.state(now=clock())
+    fired_during_overload = hot_scope in overload_state["alerts_firing"]
+
+    # Phase 3 — heal: jump past the fast window, resume healthy traffic;
+    # the fast-window burn collapses and the alert clears.
+    c.advance_clock(400)
+    for k in range(10):
+        trace = decide(f"heal-{k}")
+        slo.observe(
+            hot_scope, 0.005, shard="hot", objective_s=objective_s,
+            trace_hex=trace, now=clock(),
+        )
+        c.advance_clock(10)
+    healed_state = slo.state(now=clock())
+    hot = healed_state["scopes"][hot_scope]
+
+    incidents = slo.capture.incidents()
+    incident_meta = {}
+    trace_doc = {}
+    if len(incidents) == 1:
+        inc_dir = os.path.join(incident_root, incidents[0])
+        with open(os.path.join(inc_dir, "incident.json")) as fh:
+            incident_meta = _json.load(fh)
+        with open(os.path.join(inc_dir, "trace.json")) as fh:
+            trace_doc = _json.load(fh)
+    return {}, {
+        "alert_fired_during_overload": fired_during_overload,
+        "alert_cleared_after_heal": hot["alert_firing"] is False,
+        "exactly_one_alert_episode": hot["alerts_total"] == 1,
+        "exactly_one_incident_dump": len(incidents) == 1,
+        "incident_links_breaching_trace": (
+            breach_trace is not None
+            and incident_meta.get("trace_id") == breach_trace
+        ),
+        "incident_trace_perfetto_loadable": "traceEvents" in trace_doc,
+        "incident_flight_ring_dumped": bool(incidents)
+        and os.path.exists(
+            os.path.join(incident_root, incidents[0], "flight.jsonl")
+        ),
+    }, {
+        "burn_fast_overload": round(
+            overload_state["scopes"][hot_scope]["burn_fast"], 3
+        ),
+        "burn_fast_healed": round(hot["burn_fast"], 3),
+        "breaches_total": hot["breaches_total"],
+        "incidents": incidents,
+    }
+
+
 class _Spec:
     __slots__ = ("body", "cluster_kwargs")
 
@@ -520,6 +627,10 @@ SCENARIOS: "dict[str, _Spec]" = {
     "tiered-crash-recovery": _Spec(
         _tiered_crash_recovery, escalate_sessions=4
     ),
+    # Hot-shard SLO overload on the virtual clock: burn-rate alert fires
+    # during the slowdown, clears after the heal, exactly one
+    # exemplar-linked incident dump — the observability-plane acceptance.
+    "slo-burn": _Spec(_slo_burn),
 }
 
 
