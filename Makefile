@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-federation bench-catchup bench-gossip bench-chaos bench-churn bench-device-verify bench-slo-overhead fleet-smoke federation-smoke catchup-smoke gossip-smoke chaos-smoke churn-smoke metrics-smoke trace-smoke federation-scrape-smoke slo-overhead-smoke smoke obs-smoke
+.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-federation bench-catchup bench-gossip bench-chaos bench-liveness bench-churn bench-device-verify bench-slo-overhead fleet-smoke federation-smoke catchup-smoke gossip-smoke chaos-smoke liveness-smoke churn-smoke metrics-smoke trace-smoke federation-scrape-smoke slo-overhead-smoke smoke obs-smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -97,9 +97,10 @@ gossip-smoke:
 # Deterministic chaos harness, full depth: the scenario corpus
 # (partitions incl. asymmetric, drop/dup/reorder storms, kill-9
 # crash-restart via WAL recovery, lost-disk catch-up, equivocators,
-# forkers, expired-spam + signature-burst) at 5 pinned seeds, three
-# machine-checked verdicts per run (convergence, exact-culprit
-# accountability, honest-decision safety) + the blindness self-test.
+# forkers, expired-spam + signature-burst, liveness adversities) at 5
+# pinned seeds, four machine-checked verdicts per run (convergence,
+# exact-culprit accountability, honest-decision safety, liveness) + the
+# blindness self-test.
 bench-chaos:
 	JAX_PLATFORMS=cpu python bench.py chaos
 
@@ -109,6 +110,21 @@ bench-chaos:
 # `scenarios: {passed, failed, seeds}` block.
 chaos-smoke:
 	JAX_PLATFORMS=cpu python bench.py chaos --smoke
+
+# Liveness observatory, full depth: the Chandra–Toueg adversity trio
+# (flapping-links, slow-never-dead, stale-partial-synchrony) at 5 pinned
+# seeds with the φ-accrual A/B hard-gated — the adaptive watchdog must
+# suspect every flap the binary floor misses, zero stale convictions may
+# survive the heal in EITHER arm, and the tight-static counterfactual
+# must convict the slow-but-alive peer on every seed.
+bench-liveness:
+	JAX_PLATFORMS=cpu python bench.py liveness
+
+# CI short run: the same battery + A/B gates at 3 pinned seeds.
+# Seed-deterministic — a failure reproduces exactly from the seed in
+# the log, never a flake.
+liveness-smoke:
+	JAX_PLATFORMS=cpu python bench.py liveness --smoke
 
 # Tiered-session-lifecycle churn bench: 10M+ cumulative sessions through
 # a fixed-size engine with per-wave asserted RSS + device-slot + tier

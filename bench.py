@@ -2167,11 +2167,13 @@ def run_chaos(smoke: bool = False, seeds: "list[int] | None" = None) -> dict:
     """Deterministic chaos harness: the full scenario corpus
     (hashgraph_tpu.sim) at pinned seeds, plus the blindness self-test.
 
-    Every scenario must pass all three machine-checked verdicts —
+    Every scenario must pass all four machine-checked verdicts —
     convergence (honest state-fingerprint equality), accountability
     (exactly the injected culprits convicted, offline-verifiable
     evidence, zero honest convictions), safety (no divergent honest
-    decisions) — and a run is a pure function of its seed, so a failure
+    decisions), liveness (decisions propagate everywhere within a fixed
+    tick bound, zero honest peers left under a stale watchdog
+    conviction) — and a run is a pure function of its seed, so a failure
     here is a deterministic regression, never a flake. ``--smoke`` is
     the CI shape (3 pinned seeds); the full mode adds two more. The
     ``scenarios: {passed, failed, seeds}`` block is the machine-readable
@@ -2221,6 +2223,145 @@ def run_chaos(smoke: bool = False, seeds: "list[int] | None" = None) -> dict:
             "results": corpus["results"],
             "failures": corpus["failures"],
             "blind_selftest_detects_disabled_evidence": blind_ok,
+            "seconds": seconds,
+        },
+    }
+
+
+def run_liveness(smoke: bool = False, seeds: "list[int] | None" = None) -> dict:
+    """Liveness battery: the three liveness scenarios at pinned seeds,
+    each run TWICE per seed — the adaptive (φ-accrual) watchdog arm and
+    a paired binary-floor-only baseline (``overrides={"phi_threshold":
+    None}``, same seed, same traffic) — with the A/B claims hard-gated:
+
+    - the adaptive arm SEES every flapping-links flap (``phi`` crosses
+      the threshold on every survivor) while the static arm is blind to
+      the identical silence (130 ticks, far under the 500 000-tick
+      binary floor) — strictly more detections, same zero stale
+      convictions after heal in BOTH arms;
+    - slow-never-dead's counterfactual is the conviction half: a static
+      bar tuned tight enough to catch that flap (the scenario computes
+      ``phi_from_deviation`` for the equivalent deviation) WOULD convict
+      the slow-but-alive peer (1 stale conviction) where the variance-
+      aware φ keeps it healthy (0) — adaptive strictly fewer stale
+      convictions under jitter;
+    - stale-partial-synchrony closes the loop: when silence really does
+      blow past every bound, BOTH detectors convict, and both clear
+      after GST.
+
+    Deterministic like run_chaos: every line reproduces byte-for-byte
+    from its (scenario, seed) pair, so the asserts are regression gates,
+    not weather reports."""
+    import time as _time
+
+    from hashgraph_tpu.sim import run_scenario
+
+    if seeds is None:
+        seeds = [7, 99, 1234] if smoke else [7, 99, 1234, 31337, 777]
+    battery = ("flapping-links", "slow-never-dead", "stale-partial-synchrony")
+    t0 = _time.perf_counter()
+    results: dict = {}
+    failures: list[str] = []
+    adaptive_detections = 0
+    static_detections = 0
+    adaptive_stale = 0
+    static_stale = 0
+    counterfactual_static_convictions = 0
+    for name in battery:
+        for seed in seeds:
+            run = run_scenario(name, seed)
+            if not run["passed"]:
+                failures.append(f"{name}@{seed}")
+            entry = {
+                "passed": run["passed"],
+                "checks": run["checks"],
+                "max_decide_ticks": run["verdicts"]["liveness"][
+                    "max_decide_ticks"
+                ],
+                "stale_convictions": run["verdicts"]["liveness"][
+                    "stale_convictions"
+                ],
+            }
+            adaptive_stale += len(entry["stale_convictions"])
+            if name == "flapping-links":
+                # Paired baseline arm: identical seed + traffic, binary
+                # silence floor only (phi_threshold=None). Its four
+                # verdicts must STILL pass — the floor is a correct
+                # detector, just a blind one at sub-floor silences.
+                base = run_scenario(name, seed, overrides={"phi_threshold": None})
+                # ``passed`` gates scenario CHECKS too, and the φ-
+                # detection checks legitimately read False here — that
+                # blindness IS the baseline. The bar for this arm is the
+                # four verdicts.
+                base_ok = all(v["ok"] for v in base["verdicts"].values())
+                if not base_ok:
+                    failures.append(f"{name}@{seed}(static-arm)")
+                adaptive_detections += int(
+                    run["checks"]["phi_suspected_during_flap"]
+                )
+                static_detections += int(
+                    base["checks"]["phi_suspected_during_flap"]
+                )
+                static_stale += len(
+                    base["verdicts"]["liveness"]["stale_convictions"]
+                )
+                entry["static_arm"] = {
+                    "verdicts_ok": base_ok,
+                    "checks": base["checks"],
+                    "stale_convictions": base["verdicts"]["liveness"][
+                        "stale_convictions"
+                    ],
+                }
+            if name == "slow-never-dead":
+                # The counterfactual static bar (tuned tight enough to
+                # catch the flap) convicts the slow-but-alive peer; the
+                # deployed φ detector does not.
+                counterfactual_static_convictions += int(
+                    run["checks"]["metronome_counterfactual_convicts"]
+                )
+            results[f"{name}@{seed}"] = entry
+    seconds = round(_time.perf_counter() - t0, 3)
+    assert not failures, (
+        "liveness scenarios FAILED (deterministic — rerun these seeds): "
+        + ", ".join(failures)
+    )
+    # A/B gates, all hard: adaptive sees every flap the static floor
+    # misses, neither arm leaves a stale conviction after heal, and the
+    # tight-static counterfactual convicts where φ does not.
+    assert adaptive_detections == len(seeds) and static_detections == 0, (
+        adaptive_detections,
+        static_detections,
+    )
+    assert adaptive_stale == 0 and static_stale == 0, (
+        adaptive_stale,
+        static_stale,
+    )
+    assert counterfactual_static_convictions == len(seeds), (
+        counterfactual_static_convictions
+    )
+    total = len(battery) * len(seeds)
+    return {
+        "metric": "liveness_scenarios_passed",
+        "value": total - len(failures),
+        "unit": f"of {total} scenario-runs",
+        "detail": {
+            "battery": list(battery),
+            "seeds": seeds,
+            "results": results,
+            "ab": {
+                "flap_detections": {
+                    "adaptive": adaptive_detections,
+                    "static_floor": static_detections,
+                },
+                "stale_convictions_after_heal": {
+                    "adaptive": adaptive_stale,
+                    "static_floor": static_stale,
+                },
+                "tight_static_counterfactual_convictions": (
+                    counterfactual_static_convictions
+                ),
+                "adaptive_phi_convictions_same_traffic": 0,
+            },
             "seconds": seconds,
         },
     }
@@ -2958,11 +3099,14 @@ def run_fleet(
         for _ in range(p_count)
     ]
 
-    def run_arm(epoch: int, shard_ids) -> dict:
+    def run_arm(epoch: int, shard_ids, adaptive: bool = False) -> dict:
         """One rep of the sustained workload over ``shard_ids``' scopes:
         register, columnar-ingest via the fleet router (mixed gossip/P2P
         scopes, shuffled at proposal granularity), sweep, verify. Only
-        the ingest window feeds votes/sec (create/sweep timed apart)."""
+        the ingest window feeds votes/sec (create/sweep timed apart).
+        ``adaptive=True`` declares consensus-timeout bounds on every
+        scope so the per-scope timeout learner rides the hot path — the
+        liveness A/B's treatment arm."""
         by_shard = pick_scopes(epoch, shard_ids)
         scopes = [s for group in by_shard.values() for s in group]
         scope_shard = {
@@ -2979,6 +3123,14 @@ def run_fleet(
             # a windowed-p99 verdict against it. Generous on purpose —
             # a CI box breaching 5s would be a real regression.
             builder = builder.with_decide_p99_ms(5_000.0)
+            if adaptive:
+                # Liveness A/B treatment arm: identical workload, but
+                # every scope opts into adaptive consensus timeouts
+                # (engine/adaptive.py) so each decision feeds the
+                # learner. Advisory-only by design — the decide path is
+                # byte-identical, which is exactly what the within-noise
+                # gate below verifies.
+                builder = builder.with_timeout_bounds(0.5, 30.0)
             fleet.set_scope_config(scope, builder.build())
         t0 = time.perf_counter()
         pids = {}
@@ -3161,7 +3313,92 @@ def run_fleet(
         "votes": headline_rep["votes"],
         "tally_path": "psum" if fleet._tally() is not None else "host-sum",
     }
+    # ── Liveness block: adaptive-timeout learner ON vs OFF, paired ────
+    # Interleaved same-window arms over the identical workload; the
+    # treatment arm declares [0.5s, 30s] bounds on every scope. The
+    # learner is ADVISORY (Engine.adaptive_timeout(); timers stay
+    # embedder-owned, reference src/lib.rs:15-34), so the machine check
+    # is two-sided: enabling it on a healthy network costs nothing the
+    # window's own weather can't explain (ingest within noise of
+    # static), and it actually LEARNED (book updates land only in
+    # adaptive arms, every learned value inside the declared bounds).
+    # The conviction half of the liveness story — adaptive strictly
+    # fewer stale convictions under flapping links — is seed-
+    # deterministic and gated by `python bench.py liveness`, not by
+    # wall-clock arms.
+    def _book_updates() -> int:
+        total = 0
+        for sid in all_shards:
+            snap = fleet.shard(sid).engine.adaptive_timeout_snapshot()
+            total += snap["decays_total"] + snap["backoffs_total"]
+        return total
+
+    ab_pairs = 1 if smoke else 2
+    static_ab: list[float] = []
+    adaptive_ab: list[float] = []
+    static_updates = adaptive_updates = 0
+    last_updates = _book_updates()
+    for _ in range(ab_pairs):
+        static_ab.append(run_arm(epoch, all_shards)["votes_per_sec"])
+        epoch += 1
+        cur = _book_updates()
+        static_updates += cur - last_updates
+        last_updates = cur
+        adaptive_ab.append(
+            run_arm(epoch, all_shards, adaptive=True)["votes_per_sec"]
+        )
+        epoch += 1
+        cur = _book_updates()
+        adaptive_updates += cur - last_updates
+        last_updates = cur
+    learned_values = [
+        v
+        for sid in all_shards
+        for v in fleet.shard(sid)
+        .engine.adaptive_timeout_snapshot()["scopes"]
+        .values()
+    ]
+    bounds_held = all(0.5 <= v <= 30.0 for v in learned_values)
+    med_static = sorted(static_ab)[len(static_ab) // 2]
+    med_adaptive = sorted(adaptive_ab)[len(adaptive_ab) // 2]
+    ab_spread = max(spread_pct(static_ab), spread_pct(adaptive_ab))
+    ratio = round(med_adaptive / med_static, 4) if med_static else None
+    within_noise = ratio is not None and abs(ratio - 1.0) <= max(
+        0.10, 2.0 * ab_spread / 100.0
+    )
     slo = _slo_block(objective_ms=5_000.0)
+    liveness_block = {
+        "pass": bool(
+            within_noise
+            and adaptive_updates > 0
+            and static_updates == 0
+            and bounds_held
+        ),
+        "criterion": (
+            "adaptive-timeout arm within max(10%, 2*max_spread) of static "
+            "AND learner updates land only in adaptive arms AND every "
+            "learned timeout inside declared [0.5s, 30s] bounds"
+        ),
+        "decide_p99_ms": slo["windowed_latency_ms"]["p99"],
+        "adaptive_vs_static_ratio": ratio,
+        "within_noise": bool(within_noise),
+        "static_reps": static_ab,
+        "adaptive_reps": adaptive_ab,
+        "spread_pct": {
+            "static": spread_pct(static_ab),
+            "adaptive": spread_pct(adaptive_ab),
+        },
+        "learner": {
+            "adaptive_arm_updates": adaptive_updates,
+            "static_arm_updates": static_updates,
+            "learned_timeouts_sampled": len(learned_values),
+            "bounds_held": bool(bounds_held),
+        },
+        "stale_conviction_ab": (
+            "seed-deterministic; gated by `python bench.py liveness` "
+            "(flapping-links adaptive-vs-static arms)"
+        ),
+    }
     fleet.close()
     return {
         "metric": "fleet_aggregate_ingest_throughput",
@@ -3184,6 +3421,7 @@ def run_fleet(
             "noise_verdict": noise_verdict,
             "multichip_record": multichip_record,
             "slo": slo,
+            "liveness": liveness_block,
             "platform": jax.devices()[0].platform,
         },
     }
@@ -4101,6 +4339,7 @@ if __name__ == "__main__":
         "catchup": lambda: run_catchup(smoke=fleet_smoke),
         "gossip": lambda: run_gossip(smoke=fleet_smoke, stages=gossip_stages),
         "chaos": lambda: run_chaos(smoke=fleet_smoke),
+        "liveness": lambda: run_liveness(smoke=fleet_smoke),
         "churn": lambda: run_churn(smoke=fleet_smoke),
         "slo-overhead": lambda: run_slo_overhead(smoke=fleet_smoke),
         "slo_overhead": lambda: run_slo_overhead(smoke=fleet_smoke),

@@ -96,6 +96,21 @@ REQUIRED_FAMILIES = [
     "hashgraph_federation_remote_routed_votes_total",
     "hashgraph_federation_migrations_total",
     "hashgraph_federation_migration_seconds_bucket",
+    # Liveness observatory: φ-accrual suspicion gauges (the bare family
+    # reports the worst peer; the labelled per-peer variant appears as
+    # peers are tracked — both voters above), suspect-count gauge, and
+    # heartbeat/suspicion-edge counters.
+    "hashgraph_phi",
+    'hashgraph_phi{peer="',
+    "hashgraph_liveness_suspects",
+    "hashgraph_liveness_heartbeats_total",
+    "hashgraph_liveness_suspicion_edges_total",
+    # Overload admission control: typed RETRY_AFTER deferrals on both
+    # fabrics plus the gossip drain-pressure gauge (0 on a healthy
+    # smoke — the families must still exist).
+    "hashgraph_gossip_frames_deferred_total",
+    "hashgraph_gossip_drain_pressure",
+    "hashgraph_bridge_retry_after_total",
     # SLO plane (hashgraph_tpu.obs.slo): breach/alert counters and the
     # windowed burn-rate gauges exist from process start; the labelled
     # per-scope/per-shard variants appear once objectives are declared.

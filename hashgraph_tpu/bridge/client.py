@@ -26,6 +26,10 @@ class BridgeError(Exception):
 
     def __init__(self, status: int, message: str = ""):
         self.status = status
+        # Raw payload string, pre-formatting: typed statuses
+        # (STATUS_SHARD_MIGRATING, STATUS_RETRY_AFTER) carry their
+        # retry-after hint here as a decimal-seconds string.
+        self.message = message
         try:
             name = StatusCode(status).name
         except ValueError:

@@ -231,6 +231,13 @@ STATUS_SYNC_STALE = 245  # requested snapshot_id no longer served
 # Back off and retry — the placement flips within the window; votes are
 # never dropped, only deferred.
 STATUS_SHARD_MIGRATING = 246
+# Overload admission: the connection's in-order dispatch lane is too
+# deep to accept another state-mutating frame. The response payload is a
+# server-computed backoff hint (seconds, decimal string) derived from
+# the lane's queue depth. Semantics mirror STATUS_SHARD_MIGRATING:
+# nothing was applied, back off for the hinted window and let
+# anti-entropy repair the deferred scopes — shed, never silently lost.
+STATUS_RETRY_AFTER = 247
 STATUS_INTERNAL = 250
 
 # GET_RESULT payload byte.

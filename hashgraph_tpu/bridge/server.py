@@ -34,6 +34,7 @@ from ..events import BroadcastEventBus, EventReceiver
 from ..obs import (
     BRIDGE_ERRORS_TOTAL,
     BRIDGE_REQUESTS_TOTAL,
+    BRIDGE_RETRY_AFTER_TOTAL,
     SHM_RINGS_ATTACHED_TOTAL,
     SYNC_CHUNKS_SENT_TOTAL,
     WIRE_APPLY_SECONDS_TOTAL,
@@ -82,6 +83,12 @@ class _SerialLane:
         self._jobs: deque = deque()
         self._lock = threading.Lock()
         self._active = False
+
+    def depth(self) -> int:
+        """Queued jobs plus the one running — the overload-admission
+        signal (server answers STATUS_RETRY_AFTER past its limit)."""
+        with self._lock:
+            return len(self._jobs) + (1 if self._active else 0)
 
     def submit(self, job) -> None:
         with self._lock:
@@ -220,6 +227,7 @@ class BridgeServer:
         signer_factory: type | None = None,
         pipeline_workers: int | None = None,
         max_inflight_per_connection: int = 256,
+        ordered_admission_limit: int | None = None,
         wire_columnar: "bool | None" = None,
         host_label: str | None = None,
     ):
@@ -307,6 +315,7 @@ class BridgeServer:
         self._sidecar: MetricsSidecar | None = None
         self._m_requests = default_registry.counter(BRIDGE_REQUESTS_TOTAL)
         self._m_errors = default_registry.counter(BRIDGE_ERRORS_TOTAL)
+        self._m_retry_after = default_registry.counter(BRIDGE_RETRY_AFTER_TOTAL)
         # State sync: per-peer cached snapshot (manifest, file path),
         # rebuilt when the peer's WAL position (or the requested chunk
         # geometry) moves. ``_sync_lock`` guards only the cache dict and
@@ -331,6 +340,17 @@ class BridgeServer:
             pipeline_workers = min(8, (os.cpu_count() or 2) + 2)
         self._pipeline_workers = max(1, pipeline_workers)
         self._max_inflight = max(1, max_inflight_per_connection)
+        # Overload admission for mutating frames on pipelined/shm
+        # connections: past this serial-lane depth the server answers
+        # STATUS_RETRY_AFTER (depth-derived backoff hint) instead of
+        # queueing deeper. Defaults just under the inflight window so
+        # shedding fires BEFORE the semaphore wedges the reader thread.
+        self._admission_limit = max(
+            1,
+            ordered_admission_limit
+            if ordered_admission_limit is not None
+            else self._max_inflight * 3 // 4,
+        )
         self._pipeline_pool: ThreadPoolExecutor | None = None
         # Zero-copy wire ingest: OP_VOTE_BATCH frames whose rows all parse
         # strict-canonical land as numpy columns on ingest_wire_columnar
@@ -774,6 +794,8 @@ class BridgeServer:
         opcode, corr, cursor = P.parse_frame(body, tagged=True)
         self._m_requests.inc()
         flight_recorder.record("bridge.op", opcode=opcode)
+        if self._shed_retry_after(conn, state, opcode, corr):
+            return
         state.inflight.acquire()
         prep = self._try_vote_batch_prepare(opcode, cursor)
 
@@ -866,6 +888,41 @@ class BridgeServer:
             flight_recorder.dump("bridge-dispatch-error")
             return P.STATUS_INTERNAL, P.string(repr(exc))
 
+    def _shed_retry_after(
+        self, conn, state: _ConnState, opcode: int, corr: int
+    ) -> bool:
+        """Overload admission for one mutating frame: when the
+        connection's serial lane is at the admission limit, answer
+        STATUS_RETRY_AFTER (backoff hint in seconds, scaled to the depth
+        the sender would be queueing behind) and drop the frame —
+        nothing is applied, so the sender defers the scopes to
+        anti-entropy instead of stacking work the lane cannot reach.
+        The answer rides the TCP control lane even for shm frames
+        (clients match responses by corr id across lanes). Returns True
+        when the frame was shed."""
+        if opcode not in _ORDERED_OPCODES:
+            return False
+        depth = state.ordered.depth()
+        if depth < self._admission_limit:
+            return False
+        self._m_retry_after.inc()
+        flight_recorder.record(
+            "bridge.retry_after", opcode=opcode, depth=depth
+        )
+        # ~1ms of lane work per queued frame is the drain-time model;
+        # bounded so a pathological backlog never hints minutes.
+        retry = min(1.0, depth / 1000.0)
+        try:
+            with state.write_lock:
+                conn.sendall(
+                    P.encode_tagged_frame(
+                        P.STATUS_RETRY_AFTER, corr, P.string(f"{retry}")
+                    )
+                )
+        except OSError:
+            pass  # connection died; nothing to answer to
+        return True
+
     def _try_vote_batch_prepare(self, opcode: int, cursor: P.Cursor):
         """3-stage wire pipeline, stage 1: vote-batch frames parse AND
         submit their crypto on the calling (reader) thread — GIL-free
@@ -896,6 +953,8 @@ class BridgeServer:
         read loop. Mutating opcodes run on the connection's serial lane
         (receive order); read-only opcodes run concurrently, so their
         responses can overtake — the client matches by correlation id."""
+        if self._shed_retry_after(conn, state, opcode, corr):
+            return
         state.inflight.acquire()  # reader blocks when the window is full
         prep = self._try_vote_batch_prepare(opcode, cursor)
 

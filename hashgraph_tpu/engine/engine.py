@@ -93,7 +93,12 @@ from ..protocol import (
     validate_vote,
     validate_vote_chain,
 )
-from ..scope_config import ScopeConfig, ScopeConfigBuilder
+from ..scope_config import (
+    DEFAULT_TIMEOUT_SECONDS,
+    ScopeConfig,
+    ScopeConfigBuilder,
+)
+from .adaptive import AdaptiveTimeoutBook
 from ..service import DEFAULT_MAX_SESSIONS_PER_SCOPE, ConsensusStats
 from ..session import ConsensusConfig, ConsensusSession, ConsensusState
 from ..signing import ConsensusSignatureScheme
@@ -507,6 +512,11 @@ class TpuConsensusEngine(Generic[Scope]):
         # time; a standalone engine reports unlabelled.
         self._slo_shard: str | None = None
         self._timelines.slo_sink = self._slo_observe
+        # Adaptive consensus-timeout learner (engine/adaptive.py):
+        # advisory per-scope values the embedder polls via
+        # adaptive_timeout(). Learning shares the _health_live gate with
+        # the watchdog — WAL replay re-fires nothing and must not teach.
+        self._adaptive = AdaptiveTimeoutBook()
         # Engine-state gauges sampled at scrape time, weakly bound: a
         # collected engine's contribution vanishes instead of freezing.
         ref = weakref.ref(self)
@@ -731,6 +741,18 @@ class TpuConsensusEngine(Generic[Scope]):
             objective_s=objective,
             trace_hex=tl.trace_hex,
         )
+        # Vote-driven decisions (never timeout outcomes — those feed the
+        # backoff side) decay the scope's learned timeout toward the SLO
+        # engine's observed tail.
+        if (
+            self._health_live
+            and not tl.by_timeout
+            and cfg is not None
+            and cfg.adaptive_timeout_enabled()
+        ):
+            self._adaptive.on_decided(
+                tl.scope, cfg, slo_engine.observed_p99(tl.scope)
+            )
 
     def _ensure_unique_pid(
         self, scope: Scope, proposal: Proposal, taken: set[int] | None = None
@@ -3946,6 +3968,11 @@ class TpuConsensusEngine(Generic[Scope]):
             # multi-host fleet every process runs this collective — a
             # metrics sum across processes must report one firing.
             self._m_timeouts.inc()
+        if was_active and self._health_live:
+            # Actually-fired timeout: back off the scope's learned
+            # timeout. Ownership-independent — each process keeps its own
+            # advisory book, and identical collectives keep them aligned.
+            self._adaptive.on_timeout(scope, self._scope_configs.get(scope))
         outcome = _OUTCOME_OF_STATE.get(new_state)
         if outcome is not None:
             # Idempotent for sessions that already decided by votes (the
@@ -4040,6 +4067,11 @@ class TpuConsensusEngine(Generic[Scope]):
             # TTLs measure from it); ownership-independent like the
             # timeline stamp.
             self._records[slot].last_activity = now
+            if self._health_live:
+                swept_scope = self._records[slot].scope
+                self._adaptive.on_timeout(
+                    swept_scope, self._scope_configs.get(swept_scope)
+                )
             outcome = _OUTCOME_OF_STATE.get(new_state)
             if outcome is not None:
                 self._timelines.decided(
@@ -5006,6 +5038,23 @@ class TpuConsensusEngine(Generic[Scope]):
     def get_scope_config(self, scope: Scope) -> ScopeConfig | None:
         return self._scope_configs.get(scope)
 
+    def adaptive_timeout(self, scope: Scope) -> float:
+        """The consensus timeout the embedder should schedule next for
+        ``scope``, in seconds: the learned value when the scope declared
+        ``timeout_min``/``timeout_max`` bounds, else the scope's static
+        ``default_timeout`` (or the gossipsub default) — exactly the
+        reference behavior. Advisory only: timers stay embedder-owned
+        (reference: src/lib.rs:15-34)."""
+        cfg = self._scope_configs.get(scope)
+        learned = self._adaptive.current(scope, cfg)
+        if learned is not None:
+            return learned
+        return cfg.default_timeout if cfg is not None else DEFAULT_TIMEOUT_SECONDS
+
+    def adaptive_timeout_snapshot(self) -> dict:
+        """Learner introspection (per-scope learned values + counters)."""
+        return self._adaptive.snapshot()
+
     # ScopeConfigBuilderWrapper terminal hooks (shared with the service).
     def _initialize_scope(self, scope: Scope, config: ScopeConfig) -> None:
         self.set_scope_config(scope, config)
@@ -5022,6 +5071,9 @@ class TpuConsensusEngine(Generic[Scope]):
         existing.max_rounds_override = config.max_rounds_override
         existing.demote_after = config.demote_after
         existing.evict_decided_after = config.evict_decided_after
+        existing.decide_p99_ms = config.decide_p99_ms
+        existing.timeout_min = config.timeout_min
+        existing.timeout_max = config.timeout_max
         existing.validate()
         self._scope_configs[scope] = existing
 

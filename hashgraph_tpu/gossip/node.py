@@ -47,6 +47,7 @@ from ..obs import (
     GOSSIP_ANTI_ENTROPY_ROUNDS_TOTAL,
     GOSSIP_ANTI_ENTROPY_SESSIONS_TOTAL,
     GOSSIP_CATCHUP_ESCALATIONS_TOTAL,
+    GOSSIP_FRAMES_DEFERRED_TOTAL,
     flight_recorder,
 )
 from ..obs import registry as default_registry
@@ -149,6 +150,12 @@ class GossipNode:
         self._acked = 0
         self._rejected = 0
         self._failed_frames = 0
+        self._deferred_frames = 0
+        # peer -> wall deadline of a server-hinted backoff window
+        # (STATUS_RETRY_AFTER): until it passes, hot-path frames to that
+        # peer defer straight to anti-entropy instead of re-offering
+        # load the peer just said it cannot admit.
+        self._retry_after: dict[str, float] = {}
         self._m_rounds = default_registry.counter(
             GOSSIP_ANTI_ENTROPY_ROUNDS_TOTAL
         )
@@ -157,6 +164,9 @@ class GossipNode:
         )
         self._m_escalations = default_registry.counter(
             GOSSIP_CATCHUP_ESCALATIONS_TOTAL
+        )
+        self._m_deferred = default_registry.counter(
+            GOSSIP_FRAMES_DEFERRED_TOTAL
         )
         self._running = True
         self._flusher: threading.Thread | None = None
@@ -275,7 +285,27 @@ class GossipNode:
             if ready is not None:
                 self._send_frame(name, *ready)
 
+    def _defer_frame(self, name: str, meta) -> None:
+        """Book one hot-path frame as deferred-to-repair (server-hinted
+        overload): counted separately from failures, scopes dirty."""
+        self._m_deferred.inc()
+        with self._lock:
+            self._deferred_frames += 1
+            dirty = self._dirty.setdefault(name, set())
+            for _, scope, _count in meta:
+                dirty.add(scope)
+
     def _send_frame(self, name: str, payload: bytes, meta) -> None:
+        with self._lock:
+            until = self._retry_after.get(name)
+        if until is not None:
+            if time.monotonic() < until:
+                # The peer's backoff window is still open: don't re-offer
+                # load it just shed — anti-entropy repairs these scopes.
+                self._defer_frame(name, meta)
+                return
+            with self._lock:
+                self._retry_after.pop(name, None)
         future = self._transport.try_request(name, P.OP_VOTE_BATCH, payload)
         if future is None:
             # Shed under backpressure: the peer owes these scopes an
@@ -298,8 +328,27 @@ class GossipNode:
             statuses = parse_status_list(
                 future.result(budget if budget is not None else 0)
             )
-        except (BridgeError, BridgeConnectionLost, TimeoutError,
-                _FutureTimeout, OSError):
+        except BridgeError as exc:
+            if exc.status == P.STATUS_RETRY_AFTER:
+                # Typed overload shed: nothing was applied. Honor the
+                # server-computed hint (bounded — a garbled payload
+                # falls back to a short fixed window) and stop offering
+                # this peer hot-path load until it passes.
+                try:
+                    hint = min(5.0, max(0.0, float(exc.message)))
+                except (TypeError, ValueError):
+                    hint = 0.05
+                with self._lock:
+                    self._retry_after[name] = time.monotonic() + hint
+                self._defer_frame(name, meta)
+                return
+            with self._lock:
+                self._failed_frames += 1
+                dirty = self._dirty.setdefault(name, set())
+                for _, scope, _count in meta:
+                    dirty.add(scope)
+            return
+        except (BridgeConnectionLost, TimeoutError, _FutureTimeout, OSError):
             with self._lock:
                 self._failed_frames += 1
                 dirty = self._dirty.setdefault(name, set())
@@ -349,9 +398,11 @@ class GossipNode:
                 "acked": self._acked,
                 "rejected": self._rejected,
                 "failed_frames": self._failed_frames,
+                "deferred_frames": self._deferred_frames,
                 "shed_total": shed,
             }
             self._acked = self._rejected = self._failed_frames = 0
+            self._deferred_frames = 0
         return report
 
     # ── repair path: anti-entropy + catch-up escalation ────────────────

@@ -40,6 +40,7 @@ from concurrent.futures import Future
 from ..bridge import protocol as P
 from ..bridge.client import BridgeConnectionLost, BridgeError, ReconnectPolicy
 from ..obs import (
+    GOSSIP_DRAIN_PRESSURE,
     GOSSIP_FRAMES_SENT_TOTAL,
     GOSSIP_FRAMES_SHED_TOTAL,
     GOSSIP_INFLIGHT_REQUESTS,
@@ -206,6 +207,9 @@ class GossipTransport:
         )
         default_registry.gauge(GOSSIP_INFLIGHT_REQUESTS).add_provider(
             _weak_sample(ref, "_total_inflight"), owner=self
+        )
+        default_registry.gauge(GOSSIP_DRAIN_PRESSURE).add_provider(
+            _weak_sample(ref, "_drain_pressure"), owner=self
         )
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="gossip-transport"
@@ -462,6 +466,20 @@ class GossipTransport:
         with self._lock:
             channels = list(self._channels.values())
         return sum(ch.inflight_count() for ch in channels)
+
+    def _drain_pressure(self) -> float:
+        """Worst per-channel send-queue fill fraction in [0, 1] — how
+        close the slowest peer is to tripping the backpressure shed."""
+        with self._lock:
+            channels = list(self._channels.values())
+        return max(
+            (
+                ch.queue_bytes / ch.max_queue_bytes
+                for ch in channels
+                if ch.max_queue_bytes > 0
+            ),
+            default=0.0,
+        )
 
     # ── event loop (loop thread only below) ────────────────────────────
 

@@ -60,6 +60,10 @@ hashgraph_truncation_redeliveries_total         counter    health scorecards (la
 hashgraph_expired_gossip_total                  counter    health scorecards (stale redeliveries)
 hashgraph_{tracked_peers,evidence_records}      gauge      default health monitor
 hashgraph_stale_peers                           gauge      liveness watchdog
+hashgraph_phi (+ {peer=...})                    gauge      φ-accrual suspicion, worst peer (scrape-time)
+hashgraph_liveness_suspects                     gauge      peers past the phi threshold (scrape-time)
+hashgraph_liveness_heartbeats_total             counter    health monitor (admission heartbeats observed)
+hashgraph_liveness_suspicion_edges_total        counter    health monitor (phi rising edges)
 hashgraph_jax_live_buffer_bytes                 gauge      live JAX array bytes (scrape-time)
 hashgraph_jax_compile_cache_{hits,misses}_total  counter   persistent XLA compile cache
 hashgraph_sync_chunks_sent_total                counter    bridge sync source (snapshot chunks served)
@@ -68,6 +72,9 @@ hashgraph_sync_tail_records_total               counter    CatchUpClient (WAL ta
 hashgraph_sync_catchup_seconds                  histogram  CatchUpClient (end-to-end catch-up)
 hashgraph_gossip_frames_sent_total              counter    gossip transport (multiplexed frames out)
 hashgraph_gossip_frames_shed_total              counter    gossip transport (backpressure sheds)
+hashgraph_gossip_frames_deferred_total          counter    gossip node (typed STATUS_RETRY_AFTER deferrals)
+hashgraph_gossip_drain_pressure                 gauge      gossip send-queue saturation 0..1 (scrape-time)
+hashgraph_bridge_retry_after_total              counter    bridge admission control (overload answers sent)
 hashgraph_gossip_votes_coalesced_total          counter    vote coalescer (votes packed into batch frames)
 hashgraph_gossip_send_queue_bytes               gauge      gossip transport send queues (scrape-time)
 hashgraph_gossip_inflight_requests              gauge      gossip transport unanswered requests (scrape-time)
@@ -97,12 +104,17 @@ import re
 import time
 
 from .flight import FlightRecorder, flight_recorder
+from .accrual import PhiAccrual, phi_from_deviation
 from .health import (
     ALERTS_TOTAL,
     EQUIVOCATIONS_TOTAL,
     EVIDENCE_RECORDS,
     EXPIRED_GOSSIP_TOTAL,
     FORK_REDELIVERIES_TOTAL,
+    LIVENESS_HEARTBEATS_TOTAL,
+    LIVENESS_SUSPECTS,
+    LIVENESS_SUSPICION_EDGES_TOTAL,
+    PHI,
     STALE_PEERS,
     TRACKED_PEERS,
     TRUNCATION_REDELIVERIES_TOTAL,
@@ -250,6 +262,14 @@ GOSSIP_ANTI_ENTROPY_SESSIONS_TOTAL = (
     "hashgraph_gossip_anti_entropy_sessions_total"
 )
 GOSSIP_CATCHUP_ESCALATIONS_TOTAL = "hashgraph_gossip_catchup_escalations_total"
+# Overload admission control (ISSUE 18): frames the gossip node deferred
+# after a typed STATUS_RETRY_AFTER answer (server-computed backoff hint
+# from lane/queue depth), the server-side count of those answers, and a
+# scrape-time 0..1 saturation gauge over every transport's send queues —
+# operators see drain pressure instead of inferring it from silence.
+GOSSIP_FRAMES_DEFERRED_TOTAL = "hashgraph_gossip_frames_deferred_total"
+GOSSIP_DRAIN_PRESSURE = "hashgraph_gossip_drain_pressure"
+BRIDGE_RETRY_AFTER_TOTAL = "hashgraph_bridge_retry_after_total"
 
 # Zero-copy wire ingest (bridge._op_vote_batch columnar fast path):
 # frames taken by each path, shm ring attachments, and per-stage wall
@@ -300,8 +320,11 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         TRACKED_PEERS,
         EVIDENCE_RECORDS,
         STALE_PEERS,
+        PHI,
+        LIVENESS_SUSPECTS,
         GOSSIP_SEND_QUEUE_BYTES,
         GOSSIP_INFLIGHT_REQUESTS,
+        GOSSIP_DRAIN_PRESSURE,
     ):
         reg.gauge(name)
     for name in (
@@ -344,6 +367,10 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         GOSSIP_ANTI_ENTROPY_ROUNDS_TOTAL,
         GOSSIP_ANTI_ENTROPY_SESSIONS_TOTAL,
         GOSSIP_CATCHUP_ESCALATIONS_TOTAL,
+        GOSSIP_FRAMES_DEFERRED_TOTAL,
+        BRIDGE_RETRY_AFTER_TOTAL,
+        LIVENESS_HEARTBEATS_TOTAL,
+        LIVENESS_SUSPICION_EDGES_TOTAL,
         WIRE_COLUMNAR_FRAMES_TOTAL,
         WIRE_FALLBACK_FRAMES_TOTAL,
         WIRE_DECODE_SECONDS_TOTAL,
@@ -592,6 +619,7 @@ __all__ = [
     "MetricsRegistry",
     "MetricsSidecar",
     "PeerScorecard",
+    "PhiAccrual",
     "ProposalTimeline",
     "SloEngine",
     "TimelineStore",
@@ -609,6 +637,7 @@ __all__ = [
     "log_buckets",
     "merge_traces",
     "observed_span",
+    "phi_from_deviation",
     "registry",
     "slo_engine",
     "trace_store",

@@ -24,8 +24,13 @@ redeliveries, equivocations — into an accountable health layer:
   signature over their content, so any third party can verify the
   conflict offline without trusting this process (the BFT-accountability
   property — see PAPERS.md).
-- a **liveness watchdog** — peers silent past their sessions' timeout
-  config (falling back to ``stale_after``) are flagged stale.
+- a **liveness watchdog** — suspicion is φ-accrual-derived
+  (:mod:`hashgraph_tpu.obs.accrual`): each peer's inter-arrival history
+  on the logical clock yields a continuous ``phi`` level, and a peer
+  crosses into ``suspect``/stale when ``phi >= phi_threshold``. The old
+  binary bound stays as a back-compat floor: silence past
+  ``max(stale_after, session timeout hint)`` still convicts even when
+  the arrival history is too thin for phi to speak.
 - :class:`AlertRule` — threshold rules over registry metrics and
   scorecards. Rising edges emit a structured ``health.alert`` event into
   the flight recorder and count on ``hashgraph_alerts_total`` plus a
@@ -48,6 +53,7 @@ import threading
 from collections import deque
 from dataclasses import dataclass
 
+from .accrual import PhiAccrual
 from .flight import flight_recorder
 from .prometheus import _escape_label
 from .registry import MetricsRegistry
@@ -63,6 +69,20 @@ EXPIRED_GOSSIP_TOTAL = "hashgraph_expired_gossip_total"
 EVIDENCE_RECORDS = "hashgraph_evidence_records"
 TRACKED_PEERS = "hashgraph_tracked_peers"
 STALE_PEERS = "hashgraph_stale_peers"
+# φ-accrual liveness families (ISSUE 18): the bare PHI gauge reports the
+# worst (max) suspicion across tracked peers; per-peer labelled
+# ``hashgraph_phi{peer="..."}`` variants are installed as peers appear
+# (bounded — see _MAX_PHI_LABELS).
+PHI = "hashgraph_phi"
+LIVENESS_SUSPECTS = "hashgraph_liveness_suspects"
+LIVENESS_HEARTBEATS_TOTAL = "hashgraph_liveness_heartbeats_total"
+LIVENESS_SUSPICION_EDGES_TOTAL = "hashgraph_liveness_suspicion_edges_total"
+
+# Cap on per-peer labelled phi gauges: registry families are permanent,
+# so an open-membership fleet must not mint one per transient identity.
+_MAX_PHI_LABELS = 128
+
+DEFAULT_PHI_THRESHOLD = 8.0
 
 GRADE_HEALTHY = "healthy"
 GRADE_SUSPECT = "suspect"
@@ -99,12 +119,33 @@ class PeerScorecard:
     # sessions this peer voted on — the watchdog's per-peer staleness
     # threshold, per "the scope's timeout config".
     timeout_hint: float = 0.0
+    # φ-accrual inter-arrival history (lazily created on first
+    # admission) and the last phi-suspicion state the alert evaluator
+    # saw (rising-edge detection for the suspicion-edges counter).
+    accrual: PhiAccrual | None = None
+    phi_suspect: bool = False
 
-    def as_dict(self, now: int | None, stale_after: float) -> dict:
+    def phi(self, now: int | None) -> float:
+        """Current φ-accrual suspicion level (0.0 with no clock or no
+        usable arrival history — a thin history must never convict)."""
+        if self.accrual is None or now is None:
+            return 0.0
+        return self.accrual.phi(now)
+
+    def as_dict(
+        self,
+        now: int | None,
+        stale_after: float,
+        phi_threshold: float | None = None,
+    ) -> dict:
         threshold = max(stale_after, self.timeout_hint)
-        stale = now is not None and (now - self.last_seen) > threshold
+        phi = self.phi(now)
+        stale = now is not None and (
+            (now - self.last_seen) > threshold
+            or (phi_threshold is not None and phi >= phi_threshold)
+        )
         return {
-            "grade": self.grade(now, stale_after),
+            "grade": self.grade(now, stale_after, phi_threshold),
             "votes_admitted": self.votes_admitted,
             "invalid_signatures": self.invalid_signatures,
             "expired_gossip": self.expired_gossip,
@@ -117,14 +158,24 @@ class PeerScorecard:
             "last_seen": self.last_seen,
             "stale": stale,
             "stale_after": threshold,
+            "phi": round(phi, 3),
+            "phi_threshold": phi_threshold,
         }
 
-    def grade(self, now: int | None, stale_after: float) -> str:
+    def grade(
+        self,
+        now: int | None,
+        stale_after: float,
+        phi_threshold: float | None = None,
+    ) -> str:
         """``faulty``: signed, self-authenticating misbehavior
         (equivocation). ``suspect``: circumstantial anomalies — invalid
-        signatures, divergent (forked) redeliveries, or silence past the
-        timeout threshold — which an honest-but-broken relay can also
-        produce. ``healthy`` otherwise."""
+        signatures, divergent (forked) redeliveries, φ-accrual suspicion
+        past ``phi_threshold``, or silence past the binary timeout
+        threshold (the back-compat floor) — which an honest-but-broken
+        relay can also produce. ``healthy`` otherwise. Suspicion is
+        computed at read time, so a phi- or silence-driven conviction
+        clears itself the moment the peer's heartbeats resume."""
         if self.equivocations > 0:
             return GRADE_FAULTY
         threshold = max(stale_after, self.timeout_hint)
@@ -132,6 +183,7 @@ class PeerScorecard:
             self.invalid_signatures > 0
             or self.fork_redeliveries > 0
             or (now is not None and (now - self.last_seen) > threshold)
+            or (phi_threshold is not None and self.phi(now) >= phi_threshold)
         ):
             return GRADE_SUSPECT
         return GRADE_HEALTHY
@@ -232,6 +284,28 @@ class AlertRule:
         return cls(name, check, severity, "watchdog-flagged silent peers")
 
     @classmethod
+    def phi_suspects(
+        cls, name: str = "peer-suspect-phi", severity: str = SEVERITY_WARNING
+    ) -> "AlertRule":
+        """Fires per peer whose φ-accrual suspicion is at or past the
+        monitor's phi threshold (the continuous-confidence analogue of
+        ``peer-stale`` — see hashgraph_tpu.obs.accrual)."""
+
+        def check(view) -> list[dict]:
+            return [
+                {
+                    "peer": hexid,
+                    "phi": card["phi"],
+                    "threshold": card["phi_threshold"],
+                }
+                for hexid, card in view["peers"].items()
+                if card.get("phi_threshold") is not None
+                and card.get("phi", 0.0) >= card["phi_threshold"]
+            ]
+
+        return cls(name, check, severity, "phi-accrual suspicion past threshold")
+
+    @classmethod
     def counter_above(
         cls,
         name: str,
@@ -302,6 +376,7 @@ def default_rules() -> "list[AlertRule]":
         AlertRule.grade_at_least("peer-faulty", GRADE_FAULTY, SEVERITY_CRITICAL),
         AlertRule.grade_at_least("peer-suspect", GRADE_SUSPECT, SEVERITY_WARNING),
         AlertRule.stale_peers("peer-stale", SEVERITY_WARNING),
+        AlertRule.phi_suspects("peer-suspect-phi", SEVERITY_WARNING),
         AlertRule.scorecard_field_above(
             "invalid-signature-burst", "invalid_signatures", 3, SEVERITY_WARNING
         ),
@@ -333,10 +408,21 @@ class HealthMonitor:
         stale_after: float = 60.0,
         rules: "list[AlertRule] | None" = None,
         registry: MetricsRegistry | None = None,
+        phi_threshold: "float | None" = DEFAULT_PHI_THRESHOLD,
+        phi_window: int = 64,
+        phi_min_samples: int = 8,
     ):
         if max_peers <= 0 or max_evidence <= 0:
             raise ValueError("max_peers and max_evidence must be positive")
         self.stale_after = float(stale_after)
+        # φ-accrual suspicion bar: ``None`` disables the accrual detector
+        # entirely (pure binary-threshold watchdog — the A/B baseline and
+        # the pre-ISSUE-18 behavior).
+        self.phi_threshold = (
+            float(phi_threshold) if phi_threshold is not None else None
+        )
+        self._phi_window = int(phi_window)
+        self._phi_min_samples = int(phi_min_samples)
         self._max_peers = max_peers
         self._max_evidence = max_evidence
         self._lock = threading.Lock()
@@ -359,6 +445,11 @@ class HealthMonitor:
         # Registries whose gauges already sample this monitor (see
         # register_gauges — double registration would double-count).
         self._gauge_registries: set[int] = set()
+        # Registries that receive per-peer labelled phi gauges (strong
+        # refs — a monitor and its registries share a lifetime), plus the
+        # identities already labelled (bounded by _MAX_PHI_LABELS).
+        self._phi_registries: "list[MetricsRegistry]" = []
+        self._phi_labelled: set[bytes] = set()
         self._registry = registry if registry is not None else MetricsRegistry()
         reg = self._registry
         self._m_alerts = reg.counter(ALERTS_TOTAL)
@@ -366,6 +457,8 @@ class HealthMonitor:
         self._m_forks = reg.counter(FORK_REDELIVERIES_TOTAL)
         self._m_truncations = reg.counter(TRUNCATION_REDELIVERIES_TOTAL)
         self._m_expired = reg.counter(EXPIRED_GOSSIP_TOTAL)
+        self._m_heartbeats = reg.counter(LIVENESS_HEARTBEATS_TOTAL)
+        self._m_phi_edges = reg.counter(LIVENESS_SUSPICION_EDGES_TOTAL)
 
     # ── Recording (engine-facing; engines call under their own lock) ───
 
@@ -418,6 +511,7 @@ class HealthMonitor:
         if not counts:
             return
         max_peers = self._max_peers
+        fresh: "list[bytes] | None" = None
         with self._lock:
             if now > self.latest_now:
                 self.latest_now = now
@@ -431,11 +525,32 @@ class HealthMonitor:
                     peers[identity] = card
                     if len(peers) > max_peers:
                         self._evict_locked()
+                    if fresh is None:
+                        fresh = [identity]
+                    else:
+                        fresh.append(identity)
+                # φ-accrual heartbeat: one arrival observation per batch
+                # tick (the accrual coalesces same-tick arrivals itself).
+                accrual = card.accrual
+                if accrual is None:
+                    accrual = card.accrual = PhiAccrual(
+                        window=self._phi_window,
+                        min_samples=self._phi_min_samples,
+                    )
+                accrual.heartbeat(now)
                 card.votes_admitted += n
                 if now > card.last_seen:
                     card.last_seen = now
                 if timeout_hint > card.timeout_hint:
                     card.timeout_hint = timeout_hint
+        self._m_heartbeats.inc(len(counts))
+        # Labelled phi gauges for first-seen peers are installed OUTSIDE
+        # the monitor lock: register_gauge takes registry locks, and a
+        # scrape-side provider takes this monitor's lock — never hold
+        # both from the same side.
+        if fresh is not None and self._phi_registries:
+            for identity in fresh:
+                self._install_phi_gauge(identity)
 
     def note_invalid_signature(self, identity: bytes, now: int) -> None:
         """A vote claiming ``identity`` failed signature admission. The
@@ -574,7 +689,9 @@ class HealthMonitor:
             card = self._peers.get(identity)
             if card is None:
                 return None
-            return card.as_dict(self.latest_now, self.stale_after)
+            return card.as_dict(
+                self.latest_now, self.stale_after, self.phi_threshold
+            )
 
     def peer_count(self) -> int:
         with self._lock:
@@ -608,7 +725,7 @@ class HealthMonitor:
                 offenders[record.offender] = offenders.get(record.offender, 0) + 1
             out: dict[str, dict] = {}
             for identity, card in self._peers.items():
-                grade = card.grade(tick, self.stale_after)
+                grade = card.grade(tick, self.stale_after, self.phi_threshold)
                 if _GRADE_RANK[grade] >= rank:
                     out[identity.hex()] = {
                         "grade": grade,
@@ -625,9 +742,15 @@ class HealthMonitor:
     def _stale_locked(self, now: int | None) -> "list[str]":
         if now is None:
             return []
+        phi_threshold = self.phi_threshold
         out = []
         for identity, card in self._peers.items():
-            if (now - card.last_seen) > max(self.stale_after, card.timeout_hint):
+            if (now - card.last_seen) > max(
+                self.stale_after, card.timeout_hint
+            ) or (
+                phi_threshold is not None
+                and card.phi(now) >= phi_threshold
+            ):
                 out.append(identity.hex())
         return out
 
@@ -666,21 +789,38 @@ class HealthMonitor:
         reuse it instead of paying a second full serialization pass per
         readout."""
         reg = registry if registry is not None else self._registry
+        phi_threshold = self.phi_threshold
+        phi_edges = 0
         with self._lock:
             tick = self.latest_now if now is None else now
             if now is not None:
                 self._tick_locked(now)
+            peers_view: dict[str, dict] = {}
+            for identity, card in self._peers.items():
+                serialized = card.as_dict(
+                    tick, self.stale_after, phi_threshold
+                )
+                peers_view[identity.hex()] = serialized
+                # Rising-edge accounting for the suspicion-edges counter:
+                # one increment per not-suspect -> suspect transition as
+                # seen by the evaluator, never a ramp per poll.
+                suspect_now = (
+                    phi_threshold is not None
+                    and serialized["phi"] >= phi_threshold
+                )
+                if suspect_now and not card.phi_suspect:
+                    phi_edges += 1
+                card.phi_suspect = suspect_now
             view = {
                 "now": tick,
                 "registry": reg,
-                "peers": {
-                    identity.hex(): card.as_dict(tick, self.stale_after)
-                    for identity, card in self._peers.items()
-                },
+                "peers": peers_view,
                 "evidence": [record.as_dict() for record in self._evidence],
                 "stale": self._stale_locked(tick),
             }
             rules = list(self._rules)
+        if phi_edges:
+            self._m_phi_edges.inc(phi_edges)
         firing: list[dict] = []
         edges: list[tuple[str, str, int]] = []
         for rule in rules:
@@ -749,6 +889,7 @@ class HealthMonitor:
             "watchdog": {
                 "stale_peers": view["stale"],
                 "stale_after_default": self.stale_after,
+                "phi_threshold": self.phi_threshold,
             },
             "alerts": {
                 "firing": alerts,
@@ -768,9 +909,70 @@ class HealthMonitor:
             if id(registry) in self._gauge_registries:
                 return
             self._gauge_registries.add(id(registry))
+            self._phi_registries.append(registry)
+            known = list(self._peers)
         registry.register_gauge(TRACKED_PEERS, self.peer_count, owner=self)
         registry.register_gauge(EVIDENCE_RECORDS, self.evidence_count, owner=self)
         registry.register_gauge(STALE_PEERS, self.stale_count, owner=self)
+        registry.register_gauge(PHI, self.max_phi, owner=self)
+        registry.register_gauge(
+            LIVENESS_SUSPECTS, self.phi_suspect_count, owner=self
+        )
+        # Peers seen before this registry attached still get their
+        # labelled phi series (idempotent per identity via _phi_labelled).
+        for identity in known:
+            self._install_phi_gauge(identity)
+
+    # ── φ-accrual readout (gauge providers + labelled installs) ────────
+
+    def max_phi(self) -> float:
+        """Worst (max) φ-accrual suspicion across tracked peers at the
+        latest tick — the bare ``hashgraph_phi`` series."""
+        with self._lock:
+            tick = self.latest_now
+            return max(
+                (card.phi(tick) for card in self._peers.values()),
+                default=0.0,
+            )
+
+    def phi_suspect_count(self) -> int:
+        """Peers at or past the phi threshold right now (the
+        ``hashgraph_liveness_suspects`` gauge)."""
+        if self.phi_threshold is None:
+            return 0
+        with self._lock:
+            tick = self.latest_now
+            return sum(
+                1
+                for card in self._peers.values()
+                if card.phi(tick) >= self.phi_threshold
+            )
+
+    def _phi_sample(self, identity: bytes) -> float:
+        with self._lock:
+            card = self._peers.get(identity)
+            return card.phi(self.latest_now) if card is not None else 0.0
+
+    def _install_phi_gauge(self, identity: bytes) -> None:
+        """Mint ``hashgraph_phi{peer="<hex>"}`` on every attached
+        registry for ``identity`` (bounded; families are permanent, so an
+        evicted peer's series just reads 0.0). Never called with the
+        monitor lock held — register_gauge takes registry locks."""
+        with self._lock:
+            if (
+                identity in self._phi_labelled
+                or len(self._phi_labelled) >= _MAX_PHI_LABELS
+            ):
+                return
+            self._phi_labelled.add(identity)
+            registries = list(self._phi_registries)
+        name = f'{PHI}{{peer="{_escape_label(identity.hex())}"}}'
+        for registry in registries:
+            registry.register_gauge(
+                name,
+                lambda identity=identity: self._phi_sample(identity),
+                owner=self,
+            )
 
     def reset(self) -> None:
         """Drop every scorecard, evidence record, and alert edge (tests
@@ -781,3 +983,5 @@ class HealthMonitor:
             self._evidence_keys.clear()
             self._alert_state.clear()
             self.latest_now = 0
+            # Labelled phi installs stay (registry families are
+            # permanent); the providers read 0.0 for unknown peers.

@@ -512,6 +512,22 @@ class SloEngine:
                 self.target_quantile, self.fast_window, self._clock()
             )
 
+    def observed_p99(self, scope, *, now: float | None = None) -> float:
+        """Per-scope windowed decision-latency quantile in SECONDS (the
+        engine's ``target_quantile`` over the fast window), 0.0 while the
+        scope has no recent decisions. Public read for the adaptive
+        consensus-timeout learner (:mod:`hashgraph_tpu.engine.adaptive`),
+        which decays a scope's learned timeout toward this observation."""
+        with self._lock:
+            tracker = self._scopes.get(str(scope))
+            if tracker is None:
+                return 0.0
+            if now is None:
+                now = self._clock()
+            return tracker.window.quantile(
+                self.target_quantile, self.fast_window, now
+            )
+
     def _scope_burn(self, key: str, window: float) -> float:
         with self._lock:
             tracker = self._scopes.get(key)

@@ -45,7 +45,19 @@ class ScopeConfig:
     the scope's error budget in the SLO engine
     (:mod:`hashgraph_tpu.obs.slo`) — sustained breaching fires a
     multi-window burn-rate alert and an incident dump. None (the
-    default) = best-effort scope, tracked but never alerting."""
+    default) = best-effort scope, tracked but never alerting.
+
+    ``timeout_min`` / ``timeout_max`` bound the ADAPTIVE consensus
+    timeout (also embedder-layer — the reference's timer contract at
+    src/lib.rs:15-34 is static and embedder-supplied): when BOTH are
+    set, the engine learns a per-scope timeout between them —
+    PBFT-style multiplicative backoff each time a consensus timeout
+    actually fires, decay toward the SLO engine's observed decision
+    p99 on every successful (vote-driven) decision. Both None (the
+    default) = static ``default_timeout``, exactly the reference
+    behavior. Timeouts remain embedder-driven calls, so adaptivity is
+    WAL-replay-safe: the learner is advisory, in-memory, and paused
+    during replay."""
 
     network_type: NetworkType = NetworkType.GOSSIPSUB
     default_consensus_threshold: float = 2.0 / 3.0
@@ -55,6 +67,8 @@ class ScopeConfig:
     demote_after: float | None = None
     evict_decided_after: float | None = None
     decide_p99_ms: float | None = None
+    timeout_min: float | None = None
+    timeout_max: float | None = None
 
     def validate(self) -> None:
         """reference: src/scope_config.rs:57-69 — Some(0) override is only
@@ -77,6 +91,26 @@ class ScopeConfig:
             raise ValueError(
                 "decide_p99_ms must be positive milliseconds (or None)"
             )
+        for bound in (self.timeout_min, self.timeout_max):
+            if bound is not None and not bound > 0:
+                raise ValueError(
+                    "timeout bounds must be positive seconds (or None)"
+                )
+        if (self.timeout_min is None) != (self.timeout_max is None):
+            raise ValueError(
+                "timeout_min and timeout_max must be set together "
+                "(adaptivity needs both bounds)"
+            )
+        if (
+            self.timeout_min is not None
+            and self.timeout_max is not None
+            and self.timeout_min > self.timeout_max
+        ):
+            raise ValueError("timeout_min must not exceed timeout_max")
+
+    def adaptive_timeout_enabled(self) -> bool:
+        """True when this scope opted into the learned timeout."""
+        return self.timeout_min is not None and self.timeout_max is not None
 
     def clone(self) -> "ScopeConfig":
         return ScopeConfig(
@@ -88,6 +122,8 @@ class ScopeConfig:
             demote_after=self.demote_after,
             evict_decided_after=self.evict_decided_after,
             decide_p99_ms=self.decide_p99_ms,
+            timeout_min=self.timeout_min,
+            timeout_max=self.timeout_max,
         )
 
     @classmethod
@@ -145,6 +181,16 @@ class ScopeConfigBuilder:
         """Declare the scope's p99 decision-latency SLO in milliseconds
         (None = best-effort; tracked in the SLO engine, never alerting)."""
         self._config.decide_p99_ms = ms
+        return self
+
+    def with_timeout_bounds(
+        self, timeout_min: float | None, timeout_max: float | None
+    ) -> "ScopeConfigBuilder":
+        """Opt the scope into the ADAPTIVE consensus timeout, clamped to
+        ``[timeout_min, timeout_max]`` seconds (both None = static
+        ``default_timeout``, the reference behavior)."""
+        self._config.timeout_min = timeout_min
+        self._config.timeout_max = timeout_max
         return self
 
     def p2p_preset(self) -> "ScopeConfigBuilder":

@@ -279,6 +279,9 @@ class ConsensusService(Generic[Scope]):
             existing.max_rounds_override = config.max_rounds_override
             existing.demote_after = config.demote_after
             existing.evict_decided_after = config.evict_decided_after
+            existing.decide_p99_ms = config.decide_p99_ms
+            existing.timeout_min = config.timeout_min
+            existing.timeout_max = config.timeout_max
 
         self._storage.update_scope_config(scope, updater)
 
@@ -417,6 +420,16 @@ class ScopeConfigBuilderWrapper(Generic[Scope]):
 
     def with_evict_decided_after(self, seconds: float | None) -> "ScopeConfigBuilderWrapper[Scope]":
         self._builder.with_evict_decided_after(seconds)
+        return self
+
+    def with_decide_p99_ms(self, ms: float | None) -> "ScopeConfigBuilderWrapper[Scope]":
+        self._builder.with_decide_p99_ms(ms)
+        return self
+
+    def with_timeout_bounds(
+        self, timeout_min: float | None, timeout_max: float | None
+    ) -> "ScopeConfigBuilderWrapper[Scope]":
+        self._builder.with_timeout_bounds(timeout_min, timeout_max)
         return self
 
     def p2p_preset(self) -> "ScopeConfigBuilderWrapper[Scope]":

@@ -40,6 +40,7 @@ import shutil
 from ..bridge import protocol as P
 from ..bridge.server import BridgeServer
 from ..obs import HealthMonitor, MetricsRegistry
+from ..obs.health import DEFAULT_PHI_THRESHOLD
 from ..signing.stub import StubConsensusSigner
 from ..sync import CatchUpClient
 from ..wire import Proposal, Vote
@@ -54,13 +55,23 @@ class SimSession:
     chain (the embedder's ferry copy — each accepted vote appends here,
     every honest peer's chain is a positional prefix of it)."""
 
-    __slots__ = ("scope", "pid", "origin", "proposal")
+    __slots__ = ("scope", "pid", "origin", "proposal", "created_tick")
 
-    def __init__(self, scope: str, pid: int, origin: "SimPeer", proposal: Proposal):
+    def __init__(
+        self,
+        scope: str,
+        pid: int,
+        origin: "SimPeer",
+        proposal: Proposal,
+        created_tick: int = 0,
+    ):
         self.scope = scope
         self.pid = pid
         self.origin = origin
         self.proposal = proposal
+        # Logical tick at creation — the liveness verdict measures each
+        # session's decide latency against this.
+        self.created_tick = created_tick
 
 
 class SimPeer:
@@ -93,7 +104,9 @@ class SimPeer:
 
         cluster = self.cluster
         self.monitor = HealthMonitor(
-            registry=MetricsRegistry(), stale_after=cluster.stale_after
+            registry=MetricsRegistry(),
+            stale_after=cluster.stale_after,
+            phi_threshold=cluster.phi_threshold,
         )
         self.server = BridgeServer(
             capacity=cluster.capacity,
@@ -232,6 +245,7 @@ class SimCluster:
         *,
         fanout: int | None = None,
         stale_after: float = 10**9,
+        phi_threshold: "float | None" = DEFAULT_PHI_THRESHOLD,
         capacity: int = 64,
         voter_capacity: int = 8,
         escalate_sessions: int = 8,
@@ -243,6 +257,9 @@ class SimCluster:
         self.seed = seed
         self.fanout = fanout
         self.stale_after = stale_after
+        # φ-accrual suspicion bar for every peer's HealthMonitor (None =
+        # binary-threshold-only watchdog — the liveness A/B baseline arm).
+        self.phi_threshold = phi_threshold
         self.capacity = capacity
         self.voter_capacity = voter_capacity
         self.escalate_sessions = escalate_sessions
@@ -268,6 +285,12 @@ class SimCluster:
         self._ids = deterministic_ids(seed)
         self._ids.__enter__()
         self.sessions: list[SimSession] = []
+        # (scope, pid) -> logical tick at which the session FIRST read
+        # decided on any peer (the liveness verdict's decide-latency
+        # numerator). Stamped eagerly on the acting peer after each cast
+        # / timeout, with a late-discovery sweep (note_decisions) for
+        # sessions that decided through repair instead.
+        self.decision_ticks: "dict[tuple[str, int], int]" = {}
         self.catchups = 0
         self.peers = [SimPeer(self, i) for i in range(n_peers)]
         try:
@@ -362,7 +385,7 @@ class SimCluster:
         cursor = P.Cursor(out)
         pid = cursor.u32()
         proposal = Proposal.decode(cursor.blob())
-        session = SimSession(scope, pid, origin, proposal)
+        session = SimSession(scope, pid, origin, proposal, created_tick=now)
         self.sessions.append(session)
         origin.node.note_session(scope, pid)
         wire = proposal.encode()
@@ -445,6 +468,7 @@ class SimCluster:
         ):
             return None  # absorbed without applying (decided session)
         session.proposal.votes.append(vote)
+        self._record_decision(session, voter)
         voter.node.note_session(session.scope, session.pid)
         voter.node.submit_votes(
             session.scope, session.pid, [vote_bytes], now, local=False
@@ -566,4 +590,39 @@ class SimCluster:
             out[peer.name] = (
                 bool(P.Cursor(payload).u8()) if status == _OK else f"status {status}"
             )
+            self._record_decision(session, peer)
         return out
+
+    # ── decision-tick bookkeeping (liveness verdict) ───────────────────
+
+    def _record_decision(self, session: SimSession, peer: SimPeer) -> None:
+        """Stamp the logical tick at which ``session`` first reads
+        decided on any peer (first stamp wins; read-only OP_GET_RESULT,
+        so the extra dispatch cannot perturb the run)."""
+        key = (session.scope, session.pid)
+        if key in self.decision_ticks or peer.crashed:
+            return
+        status, payload = peer.server.dispatch_frame(
+            P.OP_GET_RESULT,
+            P.u32(peer.peer_id)
+            + P.string(session.scope)
+            + P.u32(session.pid),
+        )
+        if status != _OK:
+            return
+        if P.Cursor(payload).u8() in (P.RESULT_YES, P.RESULT_NO):
+            self.decision_ticks[key] = self.now
+
+    def note_decisions(self) -> None:
+        """Late-discovery sweep: sessions that decided through gossip
+        fan-out or anti-entropy repair (no locally-observed cast) get
+        stamped at the CURRENT tick — an upper bound on their decide
+        latency, which is all the liveness bound needs."""
+        for session in self.sessions:
+            key = (session.scope, session.pid)
+            if key in self.decision_ticks:
+                continue
+            for peer in self.live_peers():
+                self._record_decision(session, peer)
+                if key in self.decision_ticks:
+                    break

@@ -2,9 +2,10 @@
 
 Every scenario drives a :class:`~hashgraph_tpu.sim.cluster.SimCluster`
 through real traffic while injecting one family of faults, then hands
-the cluster to the three machine-checked verdicts
+the cluster to the four machine-checked verdicts
 (:mod:`hashgraph_tpu.sim.verdicts`): convergence, exact-culprit
-accountability, honest-decision safety. ``run_scenario(name, seed)`` is
+accountability, honest-decision safety, and bounded-decide /
+zero-stale-conviction liveness. ``run_scenario(name, seed)`` is
 a pure function of its arguments — same seed, byte-identical verdict
 JSON — which is what makes the corpus a regression harness rather than
 a demo: `bench.py chaos` and `make chaos-smoke` run it at pinned seeds,
@@ -28,6 +29,14 @@ The corpus (≥ the ISSUE's eight):
   lost-disk catch-up from tiered sources, fingerprint equality throughout
 - ``slo-burn``              — hot-shard overload against a declared decide
   objective: burn-rate alert fires, clears on heal, ONE incident dump
+- ``flapping-links``        — a peer's links flap far outside its heartbeat
+  cadence but far under the binary stale floor: only φ-accrual can see it,
+  and the suspicion must clear itself on heal
+- ``slow-never-dead``       — a slow-but-alive peer whose adapted φ history
+  tolerates a silence that convicts its metronome-cadence neighbours
+- ``stale-partial-synchrony`` — a stall past BOTH detectors (φ and the
+  binary floor); after GST the convictions must clear with zero operator
+  action — the liveness verdict at full strength
 
 A corpus run can also prove the harness is not blind to itself:
 ``blind=True`` disables the health/evidence layer (the deliberately
@@ -47,6 +56,7 @@ from .cluster import SimCluster
 from .verdicts import (
     accountability_verdict,
     convergence_verdict,
+    liveness_verdict,
     safety_verdict,
 )
 
@@ -69,11 +79,15 @@ def _finish(
     convergence = convergence_verdict(cluster)
     accountability = accountability_verdict(cluster, culprits)
     safety = safety_verdict(cluster)
+    # Liveness runs LAST: "the network has stabilized" means after
+    # convergence's repair rounds have run.
+    liveness = liveness_verdict(cluster)
     checks = dict(checks or {})
     passed = (
         convergence["ok"]
         and accountability["ok"]
         and safety["ok"]
+        and liveness["ok"]
         and all(checks.values())
     )
     return {
@@ -82,6 +96,7 @@ def _finish(
             "convergence": convergence,
             "accountability": accountability,
             "safety": safety,
+            "liveness": liveness,
         },
         "checks": checks,
         "network": cluster.network.stats.as_dict(),
@@ -592,6 +607,277 @@ def _slo_burn(c: SimCluster):
     }
 
 
+def _flapping_links(c: SimCluster):
+    """Link flapping: a healthy peer's links die for a stretch that is
+    ~12x its observed heartbeat cadence but four orders of magnitude
+    UNDER the binary stale floor (the sessions' 500_000-tick timeout
+    hint) — only the φ-accrual detector can see the silence. The
+    suspicion must cross the threshold while the links flap (the
+    ``peer-suspect-phi`` alert fires on every survivor), the binary
+    floor must stay untouched, and the conviction must clear ITSELF the
+    moment the links heal and heartbeats resume — zero stale
+    convictions survive into the liveness verdict."""
+    flappy = c.peer(3)
+    others = [c.peer(i) for i in (0, 1, 2)]
+    order = [c.peer(0), c.peer(1), c.peer(2), flappy]
+    # Warm cadence: rotation-cast 3 of 4 voters per round (a session
+    # decides at the 3rd vote — quorum — so a 4th cast would be
+    # absorbed unadmitted and earn NO heartbeat) at 10-tick steps;
+    # every peer accrues >= min_samples inter-arrival history.
+    for k in range(12):
+        session = c.create_session(others[k % 3], f"warm-{k}")
+        rot = order[k % 4:] + order[: k % 4]
+        for voter in rot[:3]:
+            c.cast_vote(session, voter, True)
+        c.advance_clock(10)
+    # Carrier sessions, created FULL-MESH before the flap with
+    # expected_voters past the peer count (undecidable by votes):
+    # partition-era traffic must be vote-EXTENDS, whose canonical
+    # tick-stamped bytes repair byte-identically at any later tick —
+    # creating sessions behind a partition and advancing the clock
+    # would stamp the repaired copies at repair time and break
+    # fingerprint equality (the sim's no-wall-clock contract).
+    carriers = [
+        c.create_session(c.peer(0), f"carrier-{k}", voters=8)
+        for k in range(3)
+    ]
+    # Flap: flappy's links die both ways. The survivors keep
+    # heartbeating (one vote each per carrier round) while flappy's
+    # silence grows to ~10x its observed mean inter-arrival.
+    c.network.partition(["p0", "p1", "p2"], [flappy.name])
+    for carrier in carriers:
+        for peer in others:
+            c.cast_vote(carrier, peer, True)
+        c.advance_clock(40)
+    flap_now = c.now
+    cards = [
+        peer.monitor.snapshot(now=flap_now)["peers"].get(
+            flappy.identity.hex(), {}
+        )
+        for peer in others
+    ]
+    phi_alert = all(
+        any(
+            alert["rule"] == "peer-suspect-phi"
+            for alert in peer.monitor.evaluate_alerts(now=flap_now)
+        )
+        for peer in others
+    )
+    suspected = all(
+        card.get("phi", 0.0) >= (card.get("phi_threshold") or float("inf"))
+        for card in cards
+    )
+    # The scenario's point: the silence is invisible to the binary
+    # detector (silence << the per-peer floor), yet phi convicted.
+    floor_quiet = all(
+        (flap_now - card.get("last_seen", 0)) <= card.get("stale_after", 0)
+        for card in cards
+    )
+    # Heal: links return, anti-entropy extends the carrier chains onto
+    # flappy, and fresh traffic — flappy casting FIRST, before quorum —
+    # resumes its heartbeats; read-time grading clears the suspicion
+    # with zero operator action.
+    c.network.heal_partition()
+    c.anti_entropy_round()
+    for k in range(3):
+        session = c.create_session(flappy, f"heal-{k}")
+        for voter in (flappy, others[k % 3], others[(k + 1) % 3]):
+            c.cast_vote(session, voter, True)
+        c.advance_clock(10)
+    # Settle the carriers: timeout them on a converged view so every
+    # peer decides them identically at one tick (the timeout-liveness
+    # precedent) — the liveness verdict then sees them decided, not
+    # dangling.
+    c.converge()
+    for carrier in carriers:
+        c.fire_timeout(carrier)
+    healed = [
+        peer.monitor.snapshot(now=c.now)["peers"].get(
+            flappy.identity.hex(), {}
+        )
+        for peer in others
+    ]
+    cleared = all(
+        card.get("phi", 0.0) < (card.get("phi_threshold") or float("inf"))
+        and card.get("grade") == "healthy"
+        for card in healed
+    )
+    return {}, {
+        "phi_suspected_during_flap": suspected,
+        "phi_alert_during_flap": phi_alert,
+        "binary_floor_untouched": floor_quiet,
+        "suspicion_cleared_after_heal": cleared,
+    }, {
+        "phi_during_flap": [card.get("phi") for card in cards],
+        "phi_after_heal": [card.get("phi") for card in healed],
+        "silence_during_flap": [
+            flap_now - card.get("last_seen", 0) for card in cards
+        ],
+    }
+
+
+def _slow_never_dead(c: SimCluster):
+    """A slow-but-alive peer: its heartbeat cadence is ~4-5x the dense
+    peers', with genuine jitter, so its φ-accrual history ADAPTS — a
+    60-tick probe silence that maxes phi for a 10-tick-metronome peer
+    stays unremarkable for it. The slow peer must never be suspected
+    (by phi or the floor) while the same probe silence flags its dense
+    neighbours — per-peer learned tolerance is the whole point of
+    accrual over a global timeout."""
+    from ..obs.accrual import phi_from_deviation
+
+    slow = c.peer(3)
+    dense = [c.peer(0), c.peer(1)]
+    threshold = c.peer(0).monitor.phi_threshold
+    # 37 rounds at 10 ticks: p0/p1 vote every round (metronome); the
+    # third voting slot alternates p2 / the slow peer, the slow peer on
+    # a jittered 40/50-tick schedule (8 intervals, mean 45, std 5 —
+    # past min_samples, with real variance). The slow peer votes FIRST
+    # in its rounds so its cast is admitted before the session decides.
+    slow_rounds = {0, 4, 9, 13, 18, 22, 27, 31, 36}
+    for k in range(37):
+        session = c.create_session(dense[k % 2], f"cadence-{k}")
+        third = slow if k in slow_rounds else c.peer(2)
+        for voter in (third, dense[0], dense[1]):
+            c.cast_vote(session, voter, True)
+        c.advance_clock(10)
+    # Probe: a global 60-tick silence. For the slow peer that is
+    # (60-45)/5 = 3 standard deviations (phi ~2.9 < threshold); for a
+    # metronome peer it is 50 deviations (phi clamps at max).
+    c.advance_clock(60)
+    probe_now = c.now
+    slow_flagged = any(
+        slow.identity.hex() in peer.monitor.watchdog(now=probe_now)
+        for peer in dense + [c.peer(2)]
+    )
+    dense_flagged = all(
+        dense[1 - i].identity.hex()
+        in dense[i].monitor.watchdog(now=probe_now)
+        for i in (0, 1)
+    )
+    slow_phi = max(
+        peer.monitor.snapshot(now=probe_now)["peers"]
+        .get(slow.identity.hex(), {})
+        .get("phi", 0.0)
+        for peer in dense
+    )
+    # The counterfactual, computed not simulated: the slow peer's exact
+    # silence at a metronome cadence (mean 10, floor std 1.0) would
+    # convict outright.
+    counterfactual = phi_from_deviation((60 - 10) / 1.0)
+    # Resume: rotation-cast so EVERY peer (the slow one included) gets
+    # an admitted vote — vote_all would absorb the 4th cast on an
+    # already-decided session and leave one peer heartbeat-less — and
+    # the probe-induced suspicion clears before the verdicts read the
+    # cluster.
+    order = [c.peer(0), c.peer(1), c.peer(2), slow]
+    for k in range(4):
+        session = c.create_session(dense[k % 2], f"resume-{k}")
+        rot = order[k:] + order[:k]
+        for voter in rot[:3]:
+            c.cast_vote(session, voter, True)
+        c.advance_clock(10)
+    return {}, {
+        "slow_peer_never_suspected": not slow_flagged,
+        "dense_cadence_flagged_at_probe": dense_flagged,
+        "slow_phi_below_threshold": threshold is not None
+        and slow_phi < threshold,
+        "metronome_counterfactual_convicts": threshold is not None
+        and counterfactual >= threshold,
+    }, {
+        "slow_phi_at_probe": round(slow_phi, 3),
+        "metronome_phi_counterfactual": round(counterfactual, 3),
+        "phi_threshold": threshold,
+    }
+
+
+def _stale_partial_synchrony(c: SimCluster):
+    """Partial synchrony's pathological stretch: the WHOLE fabric
+    stalls past both detectors at once — the logical clock jumps beyond
+    the binary floor (the sessions' 500_000-tick timeout hint; the
+    cluster pins ``stale_after`` under it so the hint genuinely IS the
+    floor) while φ maxes everywhere — so every monitor convicts every
+    other peer as stale while the stall lasts. Then GST passes: traffic
+    resumes, and BOTH convictions must clear on every monitor with zero
+    operator action. A silence-driven conviction that survives GST is
+    exactly what the liveness verdict's ``stale_convictions`` list
+    exists to catch. (No partition is needed: a global stall is just
+    the clock — which also keeps every session's repair tick equal to
+    its creation tick, the fingerprint-equality contract.)"""
+    order = [c.peer(i) for i in range(4)]
+    hexes = [p.identity.hex() for p in order]
+    # Warm cadence: rotation-cast (see _flapping_links) — every peer
+    # accrues phi history and a fresh last_seen before the stall.
+    for k in range(12):
+        session = c.create_session(order[k % 4], f"warm-{k}")
+        rot = order[k % 4:] + order[: k % 4]
+        for voter in rot[:3]:
+            c.cast_vote(session, voter, True)
+        c.advance_clock(10)
+    # The stall: no frames, no votes, and the logical clock jumps past
+    # the 500_000-tick floor. Every warm session is already decided, so
+    # nothing expires under the jump.
+    c.advance_clock(600_001)
+    stall_now = c.now
+    views = {
+        peer.name: peer.monitor.snapshot(now=stall_now)["peers"]
+        for peer in order
+    }
+    cross_cards = [
+        views[peer.name].get(hexid, {})
+        for peer in order
+        for hexid in hexes
+        if hexid != peer.identity.hex()
+    ]
+    floor_tripped = all(
+        card.get("stale") is True
+        and card.get("stale_after", 0) >= 500_000
+        and (stall_now - card.get("last_seen", 0)) > card.get("stale_after", 0)
+        for card in cross_cards
+    )
+    phi_maxed = all(
+        card.get("phi", 0.0) >= (card.get("phi_threshold") or float("inf"))
+        for card in cross_cards
+    )
+    convicted_everywhere = all(
+        set(hexes) - {peer.identity.hex()}
+        <= set(peer.monitor.watchdog(now=stall_now))
+        for peer in order
+    )
+    # GST: traffic resumes (rotation so every peer's cast is admitted
+    # somewhere before quorum) — heartbeats land everywhere and
+    # read-time grading clears both detectors at once.
+    for k in range(4):
+        session = c.create_session(order[k % 4], f"gst-{k}")
+        rot = order[k:] + order[:k]
+        for voter in rot[:3]:
+            c.cast_vote(session, voter, True)
+        c.advance_clock(10)
+    honest = set(hexes)
+    lingering = sorted(
+        set().union(
+            *(
+                set(peer.monitor.watchdog(now=c.now)) & honest
+                for peer in c.live_peers()
+            )
+        )
+    )
+    return {}, {
+        "floor_tripped_during_stall": floor_tripped,
+        "phi_maxed_during_stall": phi_maxed,
+        "stale_convicted_during_stall": convicted_everywhere,
+        "convictions_cleared_after_gst": not lingering,
+    }, {
+        "silence_at_stall": sorted(
+            {stall_now - card.get("last_seen", 0) for card in cross_cards}
+        ),
+        "floor_at_stall": sorted(
+            {card.get("stale_after", 0) for card in cross_cards}
+        ),
+        "lingering_convictions": lingering,
+    }
+
+
 class _Spec:
     __slots__ = ("body", "cluster_kwargs")
 
@@ -631,6 +917,18 @@ SCENARIOS: "dict[str, _Spec]" = {
     # during the slowdown, clears after the heal, exactly one
     # exemplar-linked incident dump — the observability-plane acceptance.
     "slo-burn": _Spec(_slo_burn),
+    # φ-accrual liveness battery (ISSUE 18): suspicion that only the
+    # accrual detector can see, per-peer learned tolerance, and a stall
+    # past BOTH detectors — all three must end with zero stale
+    # convictions under the fourth (liveness) verdict.
+    "flapping-links": _Spec(_flapping_links),
+    "slow-never-dead": _Spec(_slow_never_dead),
+    # stale_after pinned UNDER the sessions' timeout hint so the binary
+    # floor sits at the hint (500_000 ticks) and the 600_001-tick stall
+    # genuinely trips it.
+    "stale-partial-synchrony": _Spec(
+        _stale_partial_synchrony, stale_after=100_000.0
+    ),
 }
 
 
@@ -641,6 +939,7 @@ def run_scenario(
     root: "str | None" = None,
     blind: bool = False,
     signer_factory: "type | None" = None,
+    overrides: "dict | None" = None,
 ) -> dict:
     """One scenario at one seed -> the verdict JSON (a dict; serialize
     with ``sort_keys=True`` for the byte-identical determinism check).
@@ -648,10 +947,15 @@ def run_scenario(
     harness's self-test that a broken injector run FAILS.
     ``signer_factory`` overrides the cluster's scheme (default stub):
     the device-crypto battery re-runs the signature scenarios with
-    ``Ed25519DeviceConsensusSigner`` to prove all three verdicts hold
-    when rejects come from the device backend."""
+    ``Ed25519DeviceConsensusSigner`` to prove all four verdicts hold
+    when rejects come from the device backend. ``overrides`` merges
+    extra SimCluster kwargs over the spec's own — the liveness A/B in
+    ``bench.py`` uses ``{"phi_threshold": None}`` to run the
+    binary-watchdog-only baseline arm of the same scenario."""
     spec = SCENARIOS[name]
     kwargs = dict(spec.cluster_kwargs)
+    if overrides:
+        kwargs.update(overrides)
     if signer_factory is not None:
         kwargs["signer_factory"] = signer_factory
     owns_root = root is None

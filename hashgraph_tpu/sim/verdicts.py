@@ -1,4 +1,4 @@
-"""The three machine-checked verdicts every chaos scenario must pass.
+"""The four machine-checked verdicts every chaos scenario must pass.
 
 1. **Convergence** — every honest live peer reaches state-fingerprint
    equality (order-insensitive digest over the exact canonical session
@@ -13,6 +13,15 @@
 3. **Safety** — no two honest peers decide the same session differently
    (True on one, False on another). Undecided / failed-by-local-timeout
    states are liveness, not safety, and are reported but not violations.
+4. **Liveness** — once the network has stabilized (the verdicts run
+   after convergence's repair rounds), every session that decided
+   ANYWHERE is decided EVERYWHERE, every decision landed within a
+   seed-deterministic tick bound of its creation
+   (:attr:`SimCluster.decision_ticks`), and ZERO honest peers remain
+   under a watchdog conviction — φ-accrual or binary-floor — at verdict
+   time (a silence-driven suspicion that survives the heal is a stale
+   conviction, the exact failure the read-time grading exists to
+   prevent).
 
 A harness must be able to detect its own blindness: a run whose
 injectors fired but whose evidence layer was disabled FAILS verdict 2
@@ -162,6 +171,87 @@ def safety_verdict(cluster: SimCluster) -> dict:
         "decided_sessions": decided_sessions,
         "undecided_reads": undecided,
         "violations": violations,
+    }
+
+
+def liveness_verdict(
+    cluster: SimCluster, *, decide_bound: int = 1_000_000
+) -> dict:
+    """Decidability, decide latency, and zero stale convictions — run
+    LAST, after convergence's repair rounds, so "the network has
+    stabilized" is literally true when it reads the cluster.
+
+    Violations:
+
+    - a session decided on some live peer but not on all of them
+      (``stuck_sessions`` — decisions must propagate once repair runs);
+    - a decision that took more than ``decide_bound`` logical ticks from
+      the session's creation (``late_decisions`` — the bound is generous
+      but fixed, so a determinism regression that stalls deciding trips
+      a hard assert instead of drifting silently);
+    - any honest peer still flagged by any live peer's liveness watchdog
+      (φ-accrual or binary silence floor) at verdict time
+      (``stale_convictions`` — suspicion is graded at read time exactly
+      so heal clears it; one surviving is a bug, not a judgment call).
+
+    Sessions no peer decided are ``undecidable`` (quorum genuinely out
+    of reach — e.g. expected_voters past the live set with no timeout
+    fired) and are reported, not violations: decidability is the
+    scenario's claim to make, propagation and promptness are this
+    verdict's.
+    """
+    cluster.note_decisions()
+    stuck: "list[dict]" = []
+    late: "list[dict]" = []
+    undecidable = 0
+    max_ticks = 0
+    for session in cluster.sessions:
+        results = cluster.results(session)
+        decided = [v for v in results.values() if isinstance(v, bool)]
+        if not decided:
+            undecidable += 1
+            continue
+        if len(decided) != len(results):
+            stuck.append(
+                {
+                    "scope": session.scope,
+                    "proposal_id": session.pid,
+                    "results": {k: results[k] for k in sorted(results)},
+                }
+            )
+        tick = cluster.decision_ticks.get((session.scope, session.pid))
+        if tick is None:
+            continue
+        took = tick - session.created_tick
+        if took > max_ticks:
+            max_ticks = took
+        if took > decide_bound:
+            late.append(
+                {
+                    "scope": session.scope,
+                    "proposal_id": session.pid,
+                    "ticks": took,
+                }
+            )
+    honest = {p.identity.hex() for p in cluster.peers}
+    stale_convictions: "dict[str, list[str]]" = {}
+    for peer in cluster.live_peers():
+        flagged = set(peer.monitor.watchdog(now=cluster.now)) & honest
+        for hexid in sorted(flagged):
+            stale_convictions.setdefault(hexid, []).append(peer.name)
+    ok = not (stuck or late or stale_convictions)
+    return {
+        "ok": ok,
+        "sessions": len(cluster.sessions),
+        "decided_sessions": len(cluster.sessions) - undecidable,
+        "undecidable_sessions": undecidable,
+        "stuck_sessions": stuck,
+        "decide_bound_ticks": decide_bound,
+        "max_decide_ticks": max_ticks,
+        "late_decisions": late,
+        "stale_convictions": {
+            k: sorted(v) for k, v in sorted(stale_convictions.items())
+        },
     }
 
 

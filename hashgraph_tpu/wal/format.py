@@ -272,6 +272,12 @@ def encode_scope_config(config: ScopeConfig) -> bytes:
     tier_flags = (0 if config.demote_after is None else 1) | (
         0 if config.evict_decided_after is None else 2
     )
+    # Adaptive-timeout bounds follow the same fixed-width presence-flag
+    # pattern (bit 1 = timeout_min, bit 2 = timeout_max; validate() makes
+    # them all-or-nothing, but the bits stay independent for symmetry).
+    adaptive_flags = (0 if config.timeout_min is None else 1) | (
+        0 if config.timeout_max is None else 2
+    )
     return b"".join(
         (
             _u8(_NT_P2P if config.network_type == NetworkType.P2P else _NT_GOSSIPSUB),
@@ -283,6 +289,9 @@ def encode_scope_config(config: ScopeConfig) -> bytes:
             _u8(tier_flags),
             _f64(config.demote_after or 0.0),
             _f64(config.evict_decided_after or 0.0),
+            _u8(adaptive_flags),
+            _f64(config.timeout_min or 0.0),
+            _f64(config.timeout_max or 0.0),
         )
     )
 
@@ -297,6 +306,9 @@ def decode_scope_config(r: Reader) -> ScopeConfig:
     tier_flags = r.u8()
     demote_after = r.f64()
     evict_decided_after = r.f64()
+    adaptive_flags = r.u8()
+    timeout_min = r.f64()
+    timeout_max = r.f64()
     return ScopeConfig(
         network_type=nt,
         default_consensus_threshold=threshold,
@@ -305,6 +317,8 @@ def decode_scope_config(r: Reader) -> ScopeConfig:
         max_rounds_override=override if has_override else None,
         demote_after=demote_after if tier_flags & 1 else None,
         evict_decided_after=evict_decided_after if tier_flags & 2 else None,
+        timeout_min=timeout_min if adaptive_flags & 1 else None,
+        timeout_max=timeout_max if adaptive_flags & 2 else None,
     )
 
 
