@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-federation bench-catchup bench-gossip bench-chaos bench-liveness bench-churn bench-device-verify bench-slo-overhead fleet-smoke federation-smoke catchup-smoke gossip-smoke chaos-smoke liveness-smoke churn-smoke metrics-smoke trace-smoke federation-scrape-smoke slo-overhead-smoke smoke obs-smoke
+.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-federation bench-catchup bench-gossip bench-reactor bench-chaos bench-liveness bench-churn bench-device-verify bench-slo-overhead fleet-smoke federation-smoke catchup-smoke gossip-smoke chaos-smoke liveness-smoke churn-smoke metrics-smoke trace-smoke federation-scrape-smoke slo-overhead-smoke smoke obs-smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -84,6 +84,15 @@ catchup-smoke:
 STAGES ?= 1
 bench-gossip:
 	python bench.py gossip $(if $(filter 0,$(STAGES)),--no-stages,--stages)
+
+# Apply-reactor A/B bench: ONLY the paired reactor-off/on fabric arms on
+# dedicated peer sets (the reactor pinned per arm via gossip_peer.py
+# --reactor), gossip-frame-sized coalescer windows so the workload sits
+# in the many-small-dispatches regime the reactor amortizes. Reports a
+# noise_verdict, votes_per_dispatch per arm, and each arm's device-apply
+# share of server busy time vs the r06 66.8% attribution.
+bench-reactor:
+	python bench.py gossip --reactor-only
 
 # CI short run: 3 in-process peers — pipelining + coalescing + the
 # zero-copy columnar OP_VOTE_BATCH server path + a sampled-fanout

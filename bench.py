@@ -2628,6 +2628,8 @@ def run_gossip(
     reps: int = 3,
     smoke: bool = False,
     stages: bool = True,
+    reactor_ab: bool = True,
+    reactor_only: bool = False,
 ) -> dict:
     """Networked gossip fabric: aggregate votes/sec ACROSS A SOCKET.
 
@@ -2656,6 +2658,13 @@ def run_gossip(
     refuses the claim unless the arms separate beyond the window's own
     spread (serial-ping control as the loopback/scheduler weather
     normalizer); ``target_5x`` reports the ISSUE acceptance bar.
+
+    ``reactor_ab`` appends a SECOND paired A/B — reactor-off vs
+    reactor-on fabric arms on dedicated peer sets with the apply
+    reactor pinned per arm — reporting its own ``noise_verdict``,
+    ``votes_per_dispatch`` per arm, and each arm's device-apply share
+    of server busy time against the r06 66.8% attribution.
+    ``reactor_only`` runs just that pair (``make bench-reactor``).
 
     ``smoke`` (CI): 3 IN-PROCESS peers, tiny shapes, one A/B pair, plus
     a sampled-fanout + one-anti-entropy-round convergence phase
@@ -2728,18 +2737,33 @@ def run_gossip(
         clients.append(client)
         peer_ids.append(pid)
 
-    def build_epoch(tag: str) -> "list[tuple[str, int, list[bytes]]]":
+    def build_epoch(
+        tag: str, cs=None, pids=None, expected_voters=None
+    ) -> "list[tuple[str, int, list[bytes]]]":
         """Create + distribute p_count proposals (untimed), return
-        (scope, proposal_id, chained signed votes as wire bytes)."""
+        (scope, proposal_id, chained signed votes as wire bytes).
+        ``cs``/``pids`` target an alternate peer set (the reactor A/B
+        arms); default is the main one. ``expected_voters`` above
+        2*v_count keeps quorum unreachable: a decided session freezes
+        its chain, so votes landing in frames AFTER the decide frame
+        answer RECEIVED_HASH_MISMATCH — benign with the main arm's
+        512-vote windows (every late row shares the decide frame and
+        settles ALREADY_REACHED) but surfaced by gossip-frame-sized
+        windows, which would make acked != networked without any vote
+        actually dropping."""
+        cs = clients if cs is None else cs
+        pids = peer_ids if pids is None else pids
         out = []
         signers = [StubConsensusSigner(os.urandom(20)) for _ in range(v_count)]
         for p in range(p_count):
             scope = f"{tag}-{p}"
-            pid, blob = clients[0].create_proposal(
-                peer_ids[0], scope, now, f"p{p}", b"payload", v_count + 1, 3_600
+            pid, blob = cs[0].create_proposal(
+                pids[0], scope, now, f"p{p}", b"payload",
+                v_count + 1 if expected_voters is None else expected_voters,
+                3_600,
             )
-            for i in range(1, n_peers):
-                clients[i].process_proposal(peer_ids[i], scope, blob, now)
+            for i in range(1, len(cs)):
+                cs[i].process_proposal(pids[i], scope, blob, now)
             proposal = Proposal.decode(blob)
             votes: list[bytes] = []
             for signer in signers:
@@ -2820,11 +2844,17 @@ def run_gossip(
         "hashgraph_bridge_wire_columnar_frames_total": "columnar_frames",
         "hashgraph_bridge_wire_fallback_frames_total": "fallback_frames",
         "hashgraph_bridge_shm_rings_attached_total": "shm_rings",
+        # Dispatch amortization (ISSUE 19): fused device calls and the
+        # rows they carried — votes_per_dispatch = apply_rows /
+        # device_dispatches is the measured amortization factor.
+        "hashgraph_bridge_wire_device_dispatches_total": "device_dispatches",
+        "hashgraph_bridge_wire_apply_rows_total": "apply_rows",
     }
 
-    def scrape_stages() -> "dict[str, float]":
+    def scrape_stages(cs=None) -> "dict[str, float]":
+        cs = clients if cs is None else cs
         out = {name: 0.0 for name in _STAGE_FAMILIES.values()}
-        for client in clients[:1] if smoke else clients:
+        for client in cs[:1] if smoke else cs:
             for line in client.get_metrics().splitlines():
                 if line.startswith("#") or " " not in line:
                     continue
@@ -2840,34 +2870,235 @@ def run_gossip(
             for key in before
         }
 
-    try:
-        # Untimed warmup pair: jit at these shapes, connection setup.
-        run_serial(build_epoch("w-a"))
-        run_fabric(build_epoch("w-b"))
+    def spawn_peer_set(pin: str):
+        """A dedicated peer set with the apply reactor PINNED on/off —
+        the A/B arms must not inherit HASHGRAPH_TPU_APPLY_REACTOR from
+        the environment (the main arms deliberately do, so the CI
+        reactor smoke leg exercises the reactor on the headline path)."""
+        r_servers: list = []
+        r_procs: list = []
+        r_clients: list = []
+        r_pids: list = []
+        r_capacity = (reps + 2) * p_count + 8
+        if smoke:
+            for _ in range(n_peers):
+                server = BridgeServer(
+                    capacity=r_capacity,
+                    voter_capacity=v_count + 2,
+                    signer_factory=StubConsensusSigner,
+                    apply_reactor=(pin == "on"),
+                )
+                server.start()
+                r_servers.append(server)
+            addrs = [server.address for server in r_servers]
+        else:
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            runner = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "examples", "gossip_peer.py",
+            )
+            addrs = []
+            for _ in range(n_peers):
+                proc = subprocess.Popen(
+                    [sys.executable, runner,
+                     "--capacity", str(r_capacity),
+                     "--voter-capacity", str(v_count + 2),
+                     "--reactor", pin],
+                    stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE,
+                    env=env,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                )
+                r_procs.append(proc)
+            for proc in r_procs:
+                line = proc.stdout.readline().decode()
+                assert line.startswith("PORT "), f"peer runner said: {line!r}"
+                addrs.append(("127.0.0.1", int(line.split()[1])))
+        for address in addrs:
+            client = BridgeClient(*address, timeout=60.0)
+            pid, _identity = client.add_peer(os.urandom(32))
+            r_clients.append(client)
+            r_pids.append(pid)
+        return r_servers, r_procs, r_clients, r_pids, addrs
 
-        a_rates: list[float] = []
-        b_rates: list[float] = []
-        stage_reps: list[dict] = []
-        controls: list[float] = [control_rate()]
-        for rep in range(reps):
-            a_rates.append(networked / run_serial(build_epoch(f"r{rep}-a")))
+    def run_reactor_pair() -> dict:
+        """Paired reactor-off/on A/B on DEDICATED pinned peer sets.
+
+        Both arms run the identical fabric workload, but with
+        gossip-frame-sized coalescer windows (``flush_votes=chunk``):
+        many small pipelined OP_VOTE_BATCH frames per connection — the
+        exact per-dispatch-amortization regime the reactor exists for.
+        The off arm pays one device dispatch per frame; the on arm's
+        per-engine windows merge in-flight frames into fused dispatches.
+        Reps interleave off/on so scheduler weather hits both arms;
+        per-arm metric scrapes around each timed run attribute stage
+        seconds and ``votes_per_dispatch`` to the right arm even in
+        smoke mode, where every in-process server shares one registry."""
+        arms: dict = {}
+        try:
+            for pin in ("off", "on"):
+                servers_, procs_, clients_, pids_, addrs_ = spawn_peer_set(pin)
+                node = GossipNode(
+                    f"reactor-{pin}-driver", fanout=None, flush_votes=chunk,
+                )
+                for i, address in enumerate(addrs_):
+                    node.add_peer(f"peer{i}", *address, pids_[i])
+                arms[pin] = {
+                    "servers": servers_, "procs": procs_,
+                    "clients": clients_, "pids": pids_, "node": node,
+                }
+
+            def run_arm(arm, tag: str) -> float:
+                node, cs, pids = arm["node"], arm["clients"], arm["pids"]
+                # Quorum unreachable (see build_epoch): every row must
+                # ack, so the arms measure pure dispatch amortization
+                # with an exact acked == networked accounting even at
+                # chunk-sized flush windows.
+                epoch = build_epoch(tag, cs, pids, expected_voters=2 * v_count + 2)
+                t0 = time.perf_counter()
+                for scope, pid, votes in epoch:
+                    for part in chunks(votes):
+                        node.submit_votes(
+                            scope, pid, part, now + 1, local=False
+                        )
+                report = node.drain()
+                wall = time.perf_counter() - t0
+                assert report["acked"] == networked, (
+                    f"reactor arm {tag} dropped votes: {report}"
+                )
+                fps = {
+                    client.state_fingerprint(pid)
+                    for client, pid in zip(cs, pids)
+                }
+                assert len(fps) == 1, f"reactor arm {tag}: peers diverged"
+                return wall
+
+            # Untimed warmup per arm: jit at these shapes.
+            run_arm(arms["off"], "rw-off")
+            run_arm(arms["on"], "rw-on")
+            rates: dict = {"off": [], "on": []}
+            stage_totals = {
+                pin: {name: 0.0 for name in _STAGE_FAMILIES.values()}
+                for pin in ("off", "on")
+            }
+            for rep in range(reps):
+                for pin in ("off", "on"):
+                    before = scrape_stages(arms[pin]["clients"])
+                    rates[pin].append(
+                        networked / run_arm(arms[pin], f"rr{rep}-{pin}")
+                    )
+                    delta = stage_delta(
+                        before, scrape_stages(arms[pin]["clients"])
+                    )
+                    for key, value in delta.items():
+                        stage_totals[pin][key] += value
+        finally:
+            for arm in arms.values():
+                node = arm.get("node")
+                if node is not None:
+                    node.close()
+                for client in arm.get("clients", ()):
+                    client.close()
+                for server in arm.get("servers", ()):
+                    server.stop()
+                for proc in arm.get("procs", ()):
+                    try:
+                        proc.stdin.close()
+                        proc.wait(timeout=15)
+                    except Exception:
+                        proc.kill()
+
+        def med(values):
+            return sorted(values)[len(values) // 2]
+
+        def vpd(totals) -> float:
+            dispatches = totals.get("device_dispatches", 0.0)
+            if not dispatches:
+                return 0.0
+            return round(totals.get("apply_rows", 0.0) / dispatches, 2)
+
+        def apply_share(totals) -> float:
+            busy = sum(
+                totals[key]
+                for key in ("wire_decode_s", "crypto_s", "device_apply_s")
+            )
+            return round(totals["device_apply_s"] / busy, 3) if busy else 0.0
+
+        med_off, med_on = med(rates["off"]), med(rates["on"])
+        speedup = round(med_on / med_off, 3) if med_off else 0.0
+        max_spread = max(spread_pct(rates["off"]), spread_pct(rates["on"]))
+        separated = min(rates["on"]) > max(rates["off"])
+        outside_noise = speedup > 1.0 + 2.0 * max_spread / 100.0
+        return {
+            "noise_verdict": {
+                "pass": bool(separated and outside_noise),
+                "criterion": (
+                    "min(reactor-on reps) > max(reactor-off reps) AND "
+                    "speedup > 1 + 2*max_spread"
+                ),
+                "speedup": speedup,
+                "reactor_on_votes_per_sec": round(med_on, 1),
+                "reactor_off_votes_per_sec": round(med_off, 1),
+                "on_reps": [round(r, 1) for r in rates["on"]],
+                "off_reps": [round(r, 1) for r in rates["off"]],
+                "spread_pct": {
+                    "on": spread_pct(rates["on"]),
+                    "off": spread_pct(rates["off"]),
+                },
+            },
+            "votes_per_dispatch": {
+                "off": vpd(stage_totals["off"]),
+                "on": vpd(stage_totals["on"]),
+            },
+            "device_apply_share": {
+                "off": apply_share(stage_totals["off"]),
+                "on": apply_share(stage_totals["on"]),
+                "r06_baseline": 0.668,
+            },
+            "stage_totals": {
+                pin: {key: round(value, 4) for key, value in totals.items()}
+                for pin, totals in stage_totals.items()
+            },
+            "coalescer_flush_votes": chunk,
+        }
+
+    reactor_block = None
+    a_rates: list[float] = []
+    b_rates: list[float] = []
+    stage_reps: list[dict] = []
+    controls: list[float] = []
+    final_stages = None
+    slo_frames: list = []
+    convergence = None
+    try:
+        if not reactor_only:
+            # Untimed warmup pair: jit at these shapes, connection setup.
+            run_serial(build_epoch("w-a"))
+            run_fabric(build_epoch("w-b"))
+
             controls.append(control_rate())
-            before = scrape_stages() if stages else None
-            b_rates.append(networked / run_fabric(build_epoch(f"r{rep}-b")))
-            if stages:
-                stage_reps.append(stage_delta(before, scrape_stages()))
-            controls.append(control_rate())
-        final_stages = scrape_stages() if stages else None
-        # One OP_METRICS_PULL frame per peer: each process's windowed
-        # SLO state rides home with the bench (the peers decided the
-        # sessions, so THEIR SloEngines hold the latency windows).
-        slo_frames = [client.metrics_pull() for client in clients]
+            for rep in range(reps):
+                a_rates.append(
+                    networked / run_serial(build_epoch(f"r{rep}-a"))
+                )
+                controls.append(control_rate())
+                before = scrape_stages() if stages else None
+                b_rates.append(
+                    networked / run_fabric(build_epoch(f"r{rep}-b"))
+                )
+                if stages:
+                    stage_reps.append(stage_delta(before, scrape_stages()))
+                controls.append(control_rate())
+            final_stages = scrape_stages() if stages else None
+            # One OP_METRICS_PULL frame per peer: each process's windowed
+            # SLO state rides home with the bench (the peers decided the
+            # sessions, so THEIR SloEngines hold the latency windows).
+            slo_frames = [client.metrics_pull() for client in clients]
 
         # Smoke convergence phase: sampled fanout misses peers on
         # purpose; ONE anti-entropy round (same logical now) repairs
         # them to fingerprint-identical state.
-        convergence = None
-        if smoke:
+        if smoke and not reactor_only:
             node = GossipNode(
                 "smoke-node",
                 engine=servers[0].peer_engine(peer_ids[0]),
@@ -2899,6 +3130,9 @@ def run_gossip(
                 }
             finally:
                 node.close()
+
+        if reactor_ab or reactor_only:
+            reactor_block = run_reactor_pair()
     finally:
         for node in fabric_node:
             node.close()
@@ -2912,6 +3146,22 @@ def run_gossip(
                 proc.wait(timeout=15)
             except Exception:
                 proc.kill()
+
+    if reactor_only:
+        verdict = reactor_block["noise_verdict"]
+        return {
+            "metric": "gossip_reactor_votes_per_sec",
+            "value": verdict["reactor_on_votes_per_sec"],
+            "unit": "votes/sec",
+            "detail": {
+                "n_peers": n_peers,
+                "proposals": p_count,
+                "votes_per_proposal": v_count,
+                "chunk_votes": chunk,
+                "votes_networked_per_rep": networked,
+                "reactor_ab": reactor_block,
+            },
+        }
 
     med_a = sorted(a_rates)[len(a_rates) // 2]
     med_b = sorted(b_rates)[len(b_rates) // 2]
@@ -2991,6 +3241,7 @@ def run_gossip(
             totals[key]
             for key in ("wire_decode_s", "crypto_s", "device_apply_s")
         )
+        dispatches = totals.get("device_dispatches", 0.0)
         detail["stage_attribution"] = {
             "per_rep": stage_reps,
             "totals": totals,
@@ -2998,7 +3249,14 @@ def run_gossip(
                 key: round(totals[key] / busy, 3) if busy else 0.0
                 for key in ("wire_decode_s", "crypto_s", "device_apply_s")
             },
+            # Amortization factor: rows landed per fused device call.
+            "votes_per_dispatch": (
+                round(totals.get("apply_rows", 0.0) / dispatches, 2)
+                if dispatches else 0.0
+            ),
         }
+    if reactor_block is not None:
+        detail["reactor_ab"] = reactor_block
     if smoke:
         detail["convergence"] = convergence
     return {
@@ -4173,6 +4431,18 @@ if __name__ == "__main__":
     if "--no-stages" in args:
         args.remove("--no-stages")
         gossip_stages = False
+    # gossip --reactor-only: run ONLY the paired reactor-off/on A/B
+    # (dedicated pinned peer sets) — `make bench-reactor`'s spelling.
+    # --no-reactor-ab drops the reactor pair from the full gossip bench
+    # for minimal artifacts.
+    gossip_reactor_ab = True
+    gossip_reactor_only = False
+    if "--no-reactor-ab" in args:
+        args.remove("--no-reactor-ab")
+        gossip_reactor_ab = False
+    if "--reactor-only" in args:
+        args.remove("--reactor-only")
+        gossip_reactor_only = True
 
     fleet_smoke = "--smoke" in args
     if fleet_smoke:
@@ -4337,7 +4607,12 @@ if __name__ == "__main__":
             else run_fleet(smoke=fleet_smoke)
         ),
         "catchup": lambda: run_catchup(smoke=fleet_smoke),
-        "gossip": lambda: run_gossip(smoke=fleet_smoke, stages=gossip_stages),
+        "gossip": lambda: run_gossip(
+            smoke=fleet_smoke,
+            stages=gossip_stages,
+            reactor_ab=gossip_reactor_ab,
+            reactor_only=gossip_reactor_only,
+        ),
         "chaos": lambda: run_chaos(smoke=fleet_smoke),
         "liveness": lambda: run_liveness(smoke=fleet_smoke),
         "churn": lambda: run_churn(smoke=fleet_smoke),

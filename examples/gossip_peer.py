@@ -8,6 +8,7 @@ crypto) as a real OS process:
 
     python examples/gossip_peer.py [--capacity N] [--voter-capacity N]
                                    [--scheme stub|ethereum|ed25519]
+                                   [--reactor on|off|env]
 
 It prints ``PORT <port>`` on stdout once listening, then serves until
 stdin reaches EOF (the parent closing the pipe is the shutdown signal —
@@ -29,6 +30,10 @@ def main() -> None:
     parser.add_argument(
         "--scheme", choices=("stub", "ethereum", "ed25519"), default="stub"
     )
+    # Apply-reactor pin for A/B benches: "env" defers to the server's
+    # HASHGRAPH_TPU_APPLY_REACTOR default; on/off override it so a
+    # paired arm cannot be polluted by the environment.
+    parser.add_argument("--reactor", choices=("on", "off", "env"), default="env")
     args = parser.parse_args()
 
     # Honor JAX_PLATFORMS even where a sitecustomize already imported
@@ -58,6 +63,9 @@ def main() -> None:
         capacity=args.capacity,
         voter_capacity=args.voter_capacity,
         signer_factory=scheme,
+        apply_reactor=(
+            None if args.reactor == "env" else args.reactor == "on"
+        ),
     )
     with server:
         _host, port = server.address

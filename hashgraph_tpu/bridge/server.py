@@ -60,6 +60,7 @@ from ..types import (
 )
 from ..wire import Proposal, Vote
 from . import protocol as P
+from .reactor import ApplyReactor, reactor_enabled
 
 
 class _Peer:
@@ -132,7 +133,10 @@ class _WireFramePrep:
 class _ConnState:
     """Per-connection pipelining state (created on HELLO upgrade)."""
 
-    __slots__ = ("write_lock", "inflight", "ordered", "shm_running")
+    __slots__ = (
+        "write_lock", "inflight", "ordered", "shm_running",
+        "reactor_lock", "reactor_frames", "reactor_rows", "reactor_handles",
+    )
 
     def __init__(self, pool: ThreadPoolExecutor, max_inflight: int):
         self.write_lock = threading.Lock()
@@ -144,6 +148,15 @@ class _ConnState:
         # Flipped off when the owning TCP connection unwinds: the shm
         # serving thread (if any) watches it and exits.
         self.shm_running = True
+        # Apply-reactor bookkeeping: frames/rows this connection has
+        # queued into reactor windows but not yet had applied — the
+        # overload-admission shed counts them (a full window must not
+        # bypass admission control), and the handle deque is the
+        # ordering barrier other mutating opcodes wait on.
+        self.reactor_lock = threading.Lock()
+        self.reactor_frames = 0
+        self.reactor_rows = 0
+        self.reactor_handles: deque = deque()
 
 
 # Opcodes that execute in receive order on a pipelined connection; the
@@ -229,6 +242,7 @@ class BridgeServer:
         max_inflight_per_connection: int = 256,
         ordered_admission_limit: int | None = None,
         wire_columnar: "bool | None" = None,
+        apply_reactor: "bool | ApplyReactor | None" = None,
         host_label: str | None = None,
     ):
         self._host = host
@@ -382,6 +396,23 @@ class BridgeServer:
         self._m_shm_attached = default_registry.counter(
             SHM_RINGS_ATTACHED_TOTAL
         )
+        # Apply reactor (cross-connection continuous batching): validated
+        # columnar vote frames from ALL connections and lanes merge into
+        # per-engine micro-windows, one fused device dispatch each —
+        # amortizing the fixed XLA launch + readback cost the per-frame
+        # dispatches pay. Off by default (construction-compatible escape
+        # hatch); turn on with apply_reactor=True, an ApplyReactor
+        # instance (custom windowing), or HASHGRAPH_TPU_APPLY_REACTOR=1.
+        # start() runs its flusher thread; an embedded server leaves it
+        # in manual mode (inline, deterministic flush per dispatch).
+        if isinstance(apply_reactor, ApplyReactor):
+            self._reactor: "ApplyReactor | None" = apply_reactor
+        elif reactor_enabled(apply_reactor):
+            self._reactor = ApplyReactor()
+        else:
+            self._reactor = None
+        if self._reactor is not None and self._reactor._on_stage is None:
+            self._reactor._on_stage = self._note_reactor_stage
         # Live shm ring pairs: (rx, tx) per serving thread, torn down on
         # stop() and when the owning TCP connection closes.
         self._shm_rings: "set[tuple[object, object]]" = set()
@@ -511,6 +542,8 @@ class BridgeServer:
             max_workers=self._pipeline_workers,
             thread_name_prefix="bridge-pipeline",
         )
+        if self._reactor is not None:
+            self._reactor.start()
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
         return self.address
@@ -551,6 +584,12 @@ class BridgeServer:
         if self._pipeline_pool is not None:
             self._pipeline_pool.shutdown(wait=True)
             self._pipeline_pool = None
+        # Reactor drains AFTER the lanes (no new enqueues) and BEFORE the
+        # durable engines close: every queued window either applies or
+        # finishes its handles with the shutdown error — nothing mutates
+        # a closed WAL, and no waiter is stranded.
+        if self._reactor is not None:
+            self._reactor.stop()
         # Flush + close the per-identity WALs, then evict those engines and
         # the peers built on them: a closed WalWriter can never append
         # again, so a restarted server must rebuild each durable engine
@@ -799,40 +838,49 @@ class BridgeServer:
         state.inflight.acquire()
         prep = self._try_vote_batch_prepare(opcode, cursor)
 
+        def send(status: int, payload: bytes) -> None:
+            frame = P.encode_tagged_frame(status, corr, payload)
+            if len(frame) > tx.capacity:
+                # The ring can NEVER carry this response: answer on
+                # the TCP control lane instead (the client matches
+                # responses by corr id across lanes). Spinning on
+                # try_write would hold tx_lock forever and wedge
+                # every later response on the connection.
+                try:
+                    with state.write_lock:
+                        conn.sendall(frame)
+                except OSError:
+                    pass  # connection died; nothing to answer to
+                return
+            with tx_lock:
+                # Response ring full: the client is the sole drainer
+                # and responses are small — wait briefly rather than
+                # drop a response (a lost response hangs a future).
+                try:
+                    while not tx.try_write([frame], len(frame)):
+                        if not (self._running and state.shm_running):
+                            return
+                        time.sleep(0.0005)
+                except ValueError:
+                    return  # ring closed under us (teardown race)
+
+        if self._reactor_eligible(opcode, prep):
+            state.ordered.submit(
+                lambda: self._vote_batch_enqueue(prep, state, send)
+            )
+            return
+
         def run() -> None:
             try:
                 status, payload = self._safe_dispatch(opcode, cursor, prep)
                 if status >= P.STATUS_UNKNOWN_PEER:
                     self._m_errors.inc()
-                frame = P.encode_tagged_frame(status, corr, payload)
-                if len(frame) > tx.capacity:
-                    # The ring can NEVER carry this response: answer on
-                    # the TCP control lane instead (the client matches
-                    # responses by corr id across lanes). Spinning on
-                    # try_write would hold tx_lock forever and wedge
-                    # every later response on the connection.
-                    try:
-                        with state.write_lock:
-                            conn.sendall(frame)
-                    except OSError:
-                        pass  # connection died; nothing to answer to
-                    return
-                with tx_lock:
-                    # Response ring full: the client is the sole drainer
-                    # and responses are small — wait briefly rather than
-                    # drop a response (a lost response hangs a future).
-                    try:
-                        while not tx.try_write([frame], len(frame)):
-                            if not (self._running and state.shm_running):
-                                return
-                            time.sleep(0.0005)
-                    except ValueError:
-                        return  # ring closed under us (teardown race)
+                send(status, payload)
             finally:
                 state.inflight.release()
 
         if opcode in _ORDERED_OPCODES:
-            state.ordered.submit(run)
+            state.ordered.submit(self._barriered(state, run))
         else:
             pool = self._pipeline_pool
             if pool is None:
@@ -865,28 +913,34 @@ class BridgeServer:
         the serial loop and the pipelined workers)."""
         try:
             return self._dispatch(opcode, cursor, vote_prep)
-        except ConsensusError as exc:
+        except Exception as exc:
+            return self._map_dispatch_error(opcode, exc)
+
+    def _map_dispatch_error(self, opcode: int, exc: Exception) -> tuple[int, bytes]:
+        """The wire's error contract as a value mapping: also applied to
+        engine failures surfacing from a reactor dispatch, whose response
+        is written by a completion callback instead of _safe_dispatch."""
+        if isinstance(exc, ConsensusError):
             return int(exc.code), P.string(str(exc))
-        except ShardRecoveringError as exc:
+        if isinstance(exc, ShardRecoveringError):
             # A federation host's shard frozen mid-migration (or mid-
             # recovery): typed retry-after on the wire instead of an
             # internal error — the sender backs off and replays, so a
             # migration window never drops votes.
             retry = getattr(exc, "retry_after", 1.0)
             return P.STATUS_SHARD_MIGRATING, P.string(f"{retry}")
-        except (ValueError, KeyError, struct_error) as exc:
+        if isinstance(exc, (ValueError, KeyError, struct_error)):
             flight_recorder.record(
                 "bridge.bad_request", opcode=opcode, error=str(exc)
             )
             return P.STATUS_BAD_REQUEST, P.string(str(exc))
-        except Exception as exc:  # pragma: no cover - defensive
-            # Dispatch blew up unexpectedly (a peer engine died, a bug):
-            # preserve the ring for the postmortem before answering.
-            flight_recorder.record(
-                "bridge.dispatch_error", opcode=opcode, error=repr(exc)
-            )
-            flight_recorder.dump("bridge-dispatch-error")
-            return P.STATUS_INTERNAL, P.string(repr(exc))
+        # Dispatch blew up unexpectedly (a peer engine died, a bug):
+        # preserve the ring for the postmortem before answering.
+        flight_recorder.record(
+            "bridge.dispatch_error", opcode=opcode, error=repr(exc)
+        )
+        flight_recorder.dump("bridge-dispatch-error")
+        return P.STATUS_INTERNAL, P.string(repr(exc))
 
     def _shed_retry_after(
         self, conn, state: _ConnState, opcode: int, corr: int
@@ -899,19 +953,31 @@ class BridgeServer:
         anti-entropy instead of stacking work the lane cannot reach.
         The answer rides the TCP control lane even for shm frames
         (clients match responses by corr id across lanes). Returns True
-        when the frame was shed."""
+        when the frame was shed.
+
+        With the apply reactor on, frames the lane already handed to a
+        window are *queued work the sender is stacking up* even though
+        the lane itself is empty — they (and their rows) count toward
+        the depth signal, so a full window cannot silently bypass
+        admission control."""
         if opcode not in _ORDERED_OPCODES:
             return False
         depth = state.ordered.depth()
+        reactor_rows = 0
+        if self._reactor is not None:
+            with state.reactor_lock:
+                depth += state.reactor_frames
+                reactor_rows = state.reactor_rows
         if depth < self._admission_limit:
             return False
         self._m_retry_after.inc()
         flight_recorder.record(
             "bridge.retry_after", opcode=opcode, depth=depth
         )
-        # ~1ms of lane work per queued frame is the drain-time model;
-        # bounded so a pathological backlog never hints minutes.
-        retry = min(1.0, depth / 1000.0)
+        # ~1ms of lane work per queued frame is the drain-time model
+        # (queued reactor rows drain vectorized — ~64 rows per frame-
+        # equivalent); bounded so a backlog never hints minutes.
+        retry = min(1.0, depth / 1000.0 + reactor_rows / 64000.0)
         try:
             with state.write_lock:
                 conn.sendall(
@@ -941,6 +1007,165 @@ class BridgeServer:
         except Exception:
             return None  # lane re-decodes and answers the exact error
 
+    # ── Apply reactor (cross-connection continuous batching) ───────────
+
+    @property
+    def reactor(self) -> "ApplyReactor | None":
+        """The server's apply reactor, or None when disabled."""
+        return self._reactor
+
+    def _note_reactor_stage(self, stage: dict) -> None:
+        """Stage-attribution hook a reactor dispatch reports through —
+        the same wire crypto/apply counters the reactor-off path feeds,
+        so GET_METRICS attribution stays comparable either way."""
+        crypto = stage.get("crypto", 0.0)
+        if crypto:
+            self._m_wire_crypto_s.inc(crypto)
+        apply_s = stage.get("apply", 0.0)
+        if apply_s:
+            self._m_wire_apply_s.inc(apply_s)
+
+    def _reactor_eligible(self, opcode: int, prep) -> bool:
+        """True when a pipelined/shm frame takes the asynchronous
+        reactor path: a columnar-prepared OP_VOTE_BATCH on a server with
+        the reactor on. Everything else keeps today's lane semantics."""
+        return (
+            self._reactor is not None
+            and opcode == P.OP_VOTE_BATCH
+            and prep is not None
+            and prep is not _PREP_FALLBACK
+        )
+
+    def _barriered(self, state: _ConnState, run):
+        """Wrap a serial-lane job so it waits for the connection's
+        pending reactor windows first. With the reactor on, a lane job
+        that mutates engine state directly (ADD_PEER, object-path vote
+        frames, POLL_EVENTS, ...) must not run ahead of vote frames the
+        lane already handed to a window — receive order is the
+        contract. No-op (and no wrapper) with the reactor off."""
+        if self._reactor is None:
+            return run
+
+        def job() -> None:
+            self._reactor_barrier(state)
+            run()
+
+        return job
+
+    def _reactor_barrier(self, state: _ConnState) -> None:
+        """Flush and wait out every reactor window holding this
+        connection's enqueued frames (serial lane only, so the deque
+        holds exactly the frames received before the barrier)."""
+        if self._reactor is None:
+            return
+        with state.reactor_lock:
+            if not state.reactor_handles:
+                return
+            handles = list(state.reactor_handles)
+            state.reactor_handles.clear()
+        self._reactor.flush()
+        for handle in handles:
+            try:
+                handle.wait(30.0)
+            except Exception:
+                pass  # the frame's own response carries its error
+
+    def _vote_batch_enqueue(self, prep, state: _ConnState, send) -> None:
+        """Serial-lane half of the reactor path for ONE pipelined/shm
+        OP_VOTE_BATCH frame: re-resolve peers in receive order, enqueue
+        each columnar entry into its engine's open window, and RETURN —
+        the lane moves on while windows accumulate frames from every
+        connection. The last entry's completion callback assembles the
+        per-row statuses and writes the response; unknown peers and
+        object-path engines resolve inline exactly as the reactor-off
+        apply does."""
+        reactor = self._reactor
+        view = prep.view
+        statuses = bytearray(view.total)
+        out = np.frombuffer(statuses, np.uint8)
+        pending: list = []
+        try:
+            for entry in prep.per_peer:
+                rows = entry["rows"]
+                peer = self._peers.get(entry["peer_id"])
+                if peer is None:
+                    out[rows] = P.STATUS_UNKNOWN_PEER
+                    continue
+                engine = peer.engine
+                if not hasattr(engine, "ingest_wire_columnar"):
+                    self._apply_rows_objects(engine, entry, view, out)
+                    continue
+                prepass = (
+                    entry["prepass"] if engine is entry["engine"] else None
+                )
+                pending.append((engine, entry, prepass))
+        except Exception as exc:
+            status, payload = self._map_dispatch_error(P.OP_VOTE_BATCH, exc)
+            self._m_errors.inc()
+            send(status, payload)
+            state.inflight.release()
+            return
+        if not pending:
+            self._m_wire_columnar.inc()
+            send(P.STATUS_OK, P.u32(view.total) + bytes(statuses))
+            state.inflight.release()
+            return
+        join = {"left": len(pending), "error": None}
+        join_lock = threading.Lock()
+        frame_rows = int(view.total)
+        with state.reactor_lock:
+            state.reactor_frames += 1
+            state.reactor_rows += frame_rows
+
+        def finish(handle, rows) -> None:
+            error = handle.error
+            if error is None:
+                out[rows] = (
+                    np.asarray(handle.codes, np.int64) & 0xFF
+                ).astype(np.uint8)
+            with join_lock:
+                if error is not None and join["error"] is None:
+                    join["error"] = error
+                join["left"] -= 1
+                if join["left"]:
+                    return
+            with state.reactor_lock:
+                state.reactor_frames -= 1
+                state.reactor_rows -= frame_rows
+            error = join["error"]
+            if error is None:
+                self._m_wire_columnar.inc()
+                send(P.STATUS_OK, P.u32(view.total) + bytes(statuses))
+            else:
+                status, payload = self._map_dispatch_error(
+                    P.OP_VOTE_BATCH, error
+                )
+                self._m_errors.inc()
+                send(status, payload)
+            state.inflight.release()
+
+        for engine, entry, prepass in pending:
+            handle = reactor.submit(
+                engine,
+                entry["scopes"],
+                entry["sidx"],
+                entry["cols"],
+                entry["data"],
+                entry["offsets"],
+                view.now,
+                prepass=prepass,
+                on_done=(lambda h, r=entry["rows"]: finish(h, r)),
+            )
+            with state.reactor_lock:
+                # The deque is the barrier other mutating opcodes wait
+                # on; prune settled handles so a vote-only connection
+                # never accumulates them unboundedly.
+                while (
+                    state.reactor_handles and state.reactor_handles[0].done
+                ):
+                    state.reactor_handles.popleft()
+                state.reactor_handles.append(handle)
+
     def _dispatch_pipelined(
         self,
         conn: socket.socket,
@@ -958,23 +1183,38 @@ class BridgeServer:
         state.inflight.acquire()  # reader blocks when the window is full
         prep = self._try_vote_batch_prepare(opcode, cursor)
 
+        def send(status: int, payload: bytes) -> None:
+            try:
+                with state.write_lock:
+                    conn.sendall(
+                        P.encode_tagged_frame(status, corr, payload)
+                    )
+            except OSError:
+                pass  # connection died; nothing to answer to
+
+        if self._reactor_eligible(opcode, prep):
+            # Reactor path: the lane job only ENQUEUES the frame's
+            # entries into their engines' open windows and returns — the
+            # lane drains ahead while validated work from many
+            # connections merges into one fused dispatch. The completion
+            # callback writes the response and releases the inflight
+            # permit.
+            state.ordered.submit(
+                lambda: self._vote_batch_enqueue(prep, state, send)
+            )
+            return
+
         def run() -> None:
             try:
                 status, payload = self._safe_dispatch(opcode, cursor, prep)
                 if status >= P.STATUS_UNKNOWN_PEER:
                     self._m_errors.inc()
-                try:
-                    with state.write_lock:
-                        conn.sendall(
-                            P.encode_tagged_frame(status, corr, payload)
-                        )
-                except OSError:
-                    pass  # connection died; nothing to answer to
+                send(status, payload)
             finally:
                 state.inflight.release()
 
         if opcode in _ORDERED_OPCODES:
-            state.ordered.submit(run)
+            state.ordered.submit(self._barriered(state, run))
         else:
             pool = self._pipeline_pool
             if pool is None:
@@ -1438,6 +1678,8 @@ class BridgeServer:
         statuses = bytearray(view.total)
         out = np.frombuffer(statuses, np.uint8)
         stage: dict = {}
+        reactor = self._reactor
+        waits: list = []
         for entry in prep.per_peer:
             rows = entry["rows"]
             peer = self._peers.get(entry["peer_id"])
@@ -1451,6 +1693,26 @@ class BridgeServer:
             prepass = (
                 entry["prepass"] if engine is entry["engine"] else None
             )
+            if reactor is not None:
+                # Synchronous reactor path (non-pipelined connections,
+                # embedded dispatch_frame): enqueue so rows can merge
+                # with whatever the window already holds, flush the
+                # engine's window, and wait here. Stage seconds flow
+                # through the reactor's on_stage hook instead of the
+                # local dict.
+                handle = reactor.submit(
+                    engine,
+                    entry["scopes"],
+                    entry["sidx"],
+                    entry["cols"],
+                    entry["data"],
+                    entry["offsets"],
+                    view.now,
+                    prepass=prepass,
+                )
+                reactor.flush(engine)
+                waits.append((handle, rows))
+                continue
             codes = engine.ingest_wire_columnar(
                 entry["scopes"],
                 entry["sidx"],
@@ -1461,6 +1723,9 @@ class BridgeServer:
                 stage_seconds=stage,
                 _prepass=prepass,
             )
+            out[rows] = (np.asarray(codes, np.int64) & 0xFF).astype(np.uint8)
+        for handle, rows in waits:
+            codes = handle.wait(30.0)  # engine errors re-raise here
             out[rows] = (np.asarray(codes, np.int64) & 0xFF).astype(np.uint8)
         self._m_wire_columnar.inc()
         self._m_wire_crypto_s.inc(stage.get("crypto", 0.0))

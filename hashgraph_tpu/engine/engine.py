@@ -61,6 +61,8 @@ from ..obs import (
     VOTE_TABLE_OCCUPANCY,
     VOTES_ACCEPTED_TOTAL,
     VOTES_TOTAL,
+    WIRE_APPLY_ROWS_TOTAL,
+    WIRE_DEVICE_DISPATCHES_TOTAL,
     TimelineStore,
     flight_recorder,
     observed_span,
@@ -494,6 +496,13 @@ class TpuConsensusEngine(Generic[Scope]):
         self._m_verified_sigs_scheme = self.metrics.counter(
             f'{VERIFIED_SIGNATURES_TOTAL}{{scheme="{_escape_label(scheme.__name__)}"}}'
         )
+        # Dispatch amortization (the apply reactor's measured claim):
+        # every ingest_wire_columnar call is one fused device dispatch;
+        # rows ride along so votes_per_dispatch = rows / dispatches.
+        self._m_wire_dispatches = self.metrics.counter(
+            WIRE_DEVICE_DISPATCHES_TOTAL
+        )
+        self._m_wire_apply_rows = self.metrics.counter(WIRE_APPLY_ROWS_TOTAL)
         self._m_chain = self.metrics.histogram(CHAIN_KERNEL_SECONDS)
         self._m_device = self.metrics.histogram(DEVICE_INGEST_SECONDS)
         self._m_suffix_len = self.metrics.histogram(
@@ -3045,6 +3054,8 @@ class TpuConsensusEngine(Generic[Scope]):
         if batch:
             self._m_votes_total.inc(batch)
             self._m_batch_size.observe(batch)
+            self._m_wire_dispatches.inc()
+            self._m_wire_apply_rows.inc(batch)
             flight_recorder.record("engine.ingest_wire_columnar", votes=batch)
         statuses = np.full(batch, int(StatusCode.SESSION_NOT_FOUND), np.int32)
         if batch == 0 and not self._multihost:

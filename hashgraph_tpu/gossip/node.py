@@ -35,7 +35,11 @@ import threading
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
 
+import numpy as np
+
+from ..bridge import columnar as WC
 from ..bridge import protocol as P
+from ..bridge.reactor import ApplyReactor, reactor_enabled
 from ..bridge.client import (
     BridgeConnectionLost,
     BridgeError,
@@ -95,6 +99,7 @@ class GossipNode:
         flusher: bool = False,
         catchup_factory=None,
         shm_ring_bytes: int | None = None,
+        apply_reactor: "bool | ApplyReactor | None" = None,
     ):
         self.name = name
         self._engine = engine
@@ -121,6 +126,19 @@ class GossipNode:
             flush_interval=flush_interval,
         )
         self._escalate_sessions = escalate_sessions
+        # Local-apply reactor seam: pass the embedding BridgeServer's
+        # ApplyReactor instance so this node's local applies merge into
+        # the SAME per-engine windows as wire frames; True builds a
+        # private (manual-mode) one; None defers to the env default.
+        if isinstance(apply_reactor, ApplyReactor):
+            self._reactor: "ApplyReactor | None" = apply_reactor
+            self._owns_reactor = False
+        elif reactor_enabled(apply_reactor):
+            self._reactor = ApplyReactor()
+            self._owns_reactor = True
+        else:
+            self._reactor = None
+            self._owns_reactor = False
         self._lock = threading.Lock()
         self._peers: dict[str, _PeerInfo] = {}
         # scope -> ordered pid list; peer -> scopes owed a repair push;
@@ -147,6 +165,13 @@ class GossipNode:
         # never calls drain() doesn't accumulate resolved futures; the
         # reaped tallies feed the next drain() report.
         self._outstanding: list = []
+        # Serializes reap-and-tally against drain()'s read-and-reset:
+        # _reap pops entries under _lock but tallies them outside it, so
+        # without this barrier a background pump() could land a frame's
+        # acked counts AFTER drain() zeroed the window — the votes would
+        # vanish from every report. Held only across already-completed
+        # futures (or drain's own bounded waits), never across sends.
+        self._reap_lock = threading.Lock()
         self._acked = 0
         self._rejected = 0
         self._failed_frames = 0
@@ -250,9 +275,7 @@ class GossipNode:
         self.note_session(scope, pid)
         statuses = None
         if local and self._engine is not None:
-            statuses = self._engine.ingest_votes(
-                [(scope, Vote.decode(v)) for v in votes], now
-            )
+            statuses = self._apply_local(scope, votes, now)
         with self._lock:
             names = self._session_targets.get((scope, pid))
             if names is None:
@@ -267,6 +290,41 @@ class GossipNode:
                 if ready is not None:
                     self._send_frame(name, *ready)
         return statuses
+
+    def _apply_local(self, scope: str, votes: "list[bytes]", now: int):
+        """Apply one session's vote blobs to the local engine. With a
+        reactor and a columnar-capable engine, canonical rows enqueue as
+        ONE columnar frame-entry into the engine's open window — merging
+        with whatever wire frames the window already holds — then flush
+        the engine's window and wait (this caller needs its statuses
+        synchronously). Any non-canonical row falls the whole call back
+        to the object path, preserving exact per-row statuses."""
+        engine = self._engine
+        reactor = self._reactor
+        if (
+            reactor is not None
+            and votes
+            and hasattr(engine, "ingest_wire_columnar")
+        ):
+            offsets = np.zeros(len(votes) + 1, np.int64)
+            np.cumsum([len(v) for v in votes], out=offsets[1:])
+            data = np.frombuffer(b"".join(votes), np.uint8)
+            cols, flags = WC.parse_vote_columns(data, offsets)
+            if flags.all():
+                handle = reactor.submit(
+                    engine,
+                    [scope],
+                    np.zeros(len(votes), np.int64),
+                    cols,
+                    data,
+                    offsets,
+                    now,
+                )
+                reactor.flush(engine)
+                return np.asarray(handle.wait(30.0), np.int32)
+        return engine.ingest_votes(
+            [(scope, Vote.decode(v)) for v in votes], now
+        )
 
     def pump(self) -> None:
         """Close coalescer windows past their latency bound and reap
@@ -368,14 +426,23 @@ class GossipNode:
     def _reap(self) -> None:
         """Harvest every already-completed hot-path frame (non-blocking);
         unresolved futures stay outstanding."""
-        with self._lock:
-            done = [entry for entry in self._outstanding if entry[2].done()]
-            if not done:
-                return
-            remaining = [e for e in self._outstanding if not e[2].done()]
-            self._outstanding = remaining
-        for name, meta, future in done:
-            self._harvest(name, meta, future, None)
+        with self._reap_lock:
+            with self._lock:
+                # ONE done() probe per entry: futures resolve on the
+                # transport's reader thread, so probing once for a
+                # "done" list and again for the remainder would drop
+                # any frame that completes between the two passes —
+                # harvested by neither, its acks vanish from every
+                # report.
+                done: list = []
+                remaining: list = []
+                for entry in self._outstanding:
+                    (done if entry[2].done() else remaining).append(entry)
+                if not done:
+                    return
+                self._outstanding = remaining
+            for name, meta, future in done:
+                self._harvest(name, meta, future, None)
 
     def drain(self, timeout: float = 30.0) -> dict:
         """Flush everything pending and await every in-flight hot-path
@@ -383,26 +450,32 @@ class GossipNode:
         drain (opportunistic reaps included); failed frames (peer died
         mid-flight) mark their scopes dirty for anti-entropy."""
         self.flush_all()
-        with self._lock:
-            outstanding = self._outstanding
-            self._outstanding = []
         deadline = time.monotonic() + timeout
-        for name, meta, future in outstanding:
-            self._harvest(name, meta, future,
-                          max(0.0, deadline - time.monotonic()))
-        shed = sum(
-            ch["shed_total"] for ch in self._transport.stats().values()
-        )
-        with self._lock:
-            report = {
-                "acked": self._acked,
-                "rejected": self._rejected,
-                "failed_frames": self._failed_frames,
-                "deferred_frames": self._deferred_frames,
-                "shed_total": shed,
-            }
-            self._acked = self._rejected = self._failed_frames = 0
-            self._deferred_frames = 0
+        # _reap_lock: a background pump()'s reap may have popped frames
+        # it has not tallied yet — taking the lock here waits for those
+        # tallies to land before this window is read and reset, so no
+        # frame's counts ever fall between two reports. flush_all stays
+        # OUTSIDE the lock (its _send_frame path can reap on backlog).
+        with self._reap_lock:
+            with self._lock:
+                outstanding = self._outstanding
+                self._outstanding = []
+            for name, meta, future in outstanding:
+                self._harvest(name, meta, future,
+                              max(0.0, deadline - time.monotonic()))
+            shed = sum(
+                ch["shed_total"] for ch in self._transport.stats().values()
+            )
+            with self._lock:
+                report = {
+                    "acked": self._acked,
+                    "rejected": self._rejected,
+                    "failed_frames": self._failed_frames,
+                    "deferred_frames": self._deferred_frames,
+                    "shed_total": shed,
+                }
+                self._acked = self._rejected = self._failed_frames = 0
+                self._deferred_frames = 0
         return report
 
     # ── repair path: anti-entropy + catch-up escalation ────────────────
@@ -600,5 +673,7 @@ class GossipNode:
         self._running = False
         if self._flusher is not None:
             self._flusher.join(timeout=5)
+        if self._owns_reactor and self._reactor is not None:
+            self._reactor.stop()
         if self._owns_transport:
             self._transport.close()

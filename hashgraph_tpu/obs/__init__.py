@@ -282,6 +282,30 @@ WIRE_DECODE_SECONDS_TOTAL = "hashgraph_bridge_wire_decode_seconds_total"
 WIRE_CRYPTO_SECONDS_TOTAL = "hashgraph_bridge_wire_crypto_seconds_total"
 WIRE_APPLY_SECONDS_TOTAL = "hashgraph_bridge_wire_apply_seconds_total"
 SHM_RINGS_ATTACHED_TOTAL = "hashgraph_bridge_shm_rings_attached_total"
+# Device-dispatch amortization (ISSUE 19): how many fused
+# ingest_wire_columnar dispatches the bridge layer actually issued and
+# how many vote rows rode them — the bench's votes_per_dispatch line is
+# apply_rows / device_dispatches, measured, not asserted. Both paths
+# (reactor on AND off) increment these at the engine-call site.
+WIRE_DEVICE_DISPATCHES_TOTAL = "hashgraph_bridge_wire_device_dispatches_total"
+WIRE_APPLY_ROWS_TOTAL = "hashgraph_bridge_wire_apply_rows_total"
+
+# Apply reactor (ISSUE 19): the cross-connection continuous-batching
+# scheduler on the wire path. Windows = fused dispatch units flushed;
+# rows = vote rows that rode a window; the flush_* family breaks the
+# flush decisions down by reason (the registry's counters are
+# label-free, so "flushes_by_reason" is one counter per reason).
+# Occupancy (frames merged per window) and rows-per-dispatch land on
+# size-bucket histograms.
+REACTOR_WINDOWS_TOTAL = "hashgraph_reactor_windows_total"
+REACTOR_ROWS_TOTAL = "hashgraph_reactor_rows_total"
+REACTOR_FLUSH_ROWS_TOTAL = "hashgraph_reactor_flush_rows_total"
+REACTOR_FLUSH_BYTES_TOTAL = "hashgraph_reactor_flush_bytes_total"
+REACTOR_FLUSH_DEADLINE_TOTAL = "hashgraph_reactor_flush_deadline_total"
+REACTOR_FLUSH_NOW_CHANGE_TOTAL = "hashgraph_reactor_flush_now_change_total"
+REACTOR_FLUSH_FORCED_TOTAL = "hashgraph_reactor_flush_forced_total"
+REACTOR_WINDOW_OCCUPANCY = "hashgraph_reactor_window_occupancy"
+REACTOR_ROWS_PER_DISPATCH = "hashgraph_reactor_rows_per_dispatch"
 
 # Process-wide default registry (mirrors tracing.tracer's role).
 registry = MetricsRegistry()
@@ -305,6 +329,8 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         reg.histogram(name, DEFAULT_TIME_BUCKETS)
     reg.histogram(INGEST_BATCH_SIZE, DEFAULT_SIZE_BUCKETS)
     reg.histogram(CHAIN_SUFFIX_LENGTH, DEFAULT_SIZE_BUCKETS)
+    reg.histogram(REACTOR_WINDOW_OCCUPANCY, DEFAULT_SIZE_BUCKETS)
+    reg.histogram(REACTOR_ROWS_PER_DISPATCH, DEFAULT_SIZE_BUCKETS)
     for name in (
         LIVE_PROPOSALS,
         VOTE_TABLE_OCCUPANCY,
@@ -376,6 +402,15 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         WIRE_DECODE_SECONDS_TOTAL,
         WIRE_CRYPTO_SECONDS_TOTAL,
         WIRE_APPLY_SECONDS_TOTAL,
+        WIRE_DEVICE_DISPATCHES_TOTAL,
+        WIRE_APPLY_ROWS_TOTAL,
+        REACTOR_WINDOWS_TOTAL,
+        REACTOR_ROWS_TOTAL,
+        REACTOR_FLUSH_ROWS_TOTAL,
+        REACTOR_FLUSH_BYTES_TOTAL,
+        REACTOR_FLUSH_DEADLINE_TOTAL,
+        REACTOR_FLUSH_NOW_CHANGE_TOTAL,
+        REACTOR_FLUSH_FORCED_TOTAL,
         SHM_RINGS_ATTACHED_TOTAL,
         SLO_BREACHES_TOTAL,
         SLO_ALERTS_TOTAL,

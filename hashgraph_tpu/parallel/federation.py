@@ -1337,10 +1337,14 @@ class FederationDriver:
 
     def _reap(self) -> None:
         with self._lock:
-            done = [e for e in self._outstanding if e[0].done()]
-            self._outstanding = [
-                e for e in self._outstanding if not e[0].done()
-            ]
+            # ONE done() probe per entry: a future resolving between a
+            # "done" pass and a "not done" pass would land in neither
+            # list and its frame's tallies would vanish unharvested.
+            done: list = []
+            remaining: list = []
+            for entry in self._outstanding:
+                (done if entry[0].done() else remaining).append(entry)
+            self._outstanding = remaining
         for future, payload in done:
             self._harvest(future, payload, None)
 

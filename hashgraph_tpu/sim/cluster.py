@@ -116,6 +116,7 @@ class SimPeer:
             signer_factory=cluster.signer_factory,
             health_monitor=self.monitor,
             wire_columnar=cluster.wire_columnar,
+            apply_reactor=cluster.apply_reactor,
         )
         self.server.start_embedded()
         status, out = self.server.dispatch_frame(
@@ -252,6 +253,7 @@ class SimCluster:
         signer_factory: type = StubConsensusSigner,
         base_delay: int = 1,
         wire_columnar: "bool | None" = None,
+        apply_reactor: "bool | None" = None,
     ):
         self.root = root
         self.seed = seed
@@ -269,6 +271,12 @@ class SimCluster:
         # functions of their arguments, and the columnar-wire scenario
         # pins this True so the env cannot change what it asserts.
         self.wire_columnar = wire_columnar
+        # Apply-reactor override, same contract as wire_columnar. In the
+        # sim the server stays embedded (never start()ed), so the
+        # reactor runs in manual mode: submit + flush inline on the
+        # dispatching tick — windows merge deterministically, no threads
+        # and no wall-clock deadlines enter the simulation.
+        self.apply_reactor = apply_reactor
         self.scheduler = SimScheduler(seed)
         self.network = SimNetwork(self.scheduler, base_delay=base_delay)
         # The CONSENSUS clock: the logical `now` every engine call gets.

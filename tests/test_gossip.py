@@ -572,6 +572,45 @@ class TestGossipNode:
         for srv in servers:
             srv.stop()
 
+    def test_reap_single_done_probe_never_drops_a_frame(self):
+        """Regression: _reap used to probe ``future.done()`` twice (one
+        comprehension for the harvested list, one for the remainder). A
+        future resolving on the transport reader thread BETWEEN the two
+        probes landed in neither list — the frame vanished unharvested
+        and its acks fell out of every drain report. A future whose
+        done() flips mid-reap must still be tallied exactly once."""
+        from concurrent.futures import Future
+
+        class _FlipFuture(Future):
+            """done() lies False on the first probe, True after — the
+            narrowest emulation of a frame completing mid-reap."""
+
+            def __init__(self, payload):
+                super().__init__()
+                self.set_result(payload)
+                self._probes = 0
+
+            def done(self):
+                self._probes += 1
+                return self._probes > 1
+
+        node = GossipNode("driver")
+        try:
+            response = P.Cursor(
+                P.u32(3)
+                + bytes([0, 0, int(StatusCode.ALREADY_REACHED)])
+            )
+            meta = [(1, "scope", 3)]
+            node._outstanding.append(
+                ("peerX", meta, _FlipFuture(response))
+            )
+            node._reap()  # the buggy version dropped the entry here
+            report = node.drain()
+            assert report["acked"] == 3, report
+            assert report["failed_frames"] == 0
+        finally:
+            node.close()
+
     def test_fanout_delivers_to_every_peer(self):
         servers, clients, peers = self._mesh(2)
         node = GossipNode("driver", fanout=None)
