@@ -7,7 +7,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-federation bench-catchup bench-gossip bench-reactor bench-chaos bench-liveness bench-churn bench-device-verify bench-slo-overhead fleet-smoke federation-smoke catchup-smoke gossip-smoke chaos-smoke liveness-smoke churn-smoke metrics-smoke trace-smoke federation-scrape-smoke slo-overhead-smoke smoke obs-smoke
+.PHONY: verify tier1 dev-install test bench bench-redelivery bench-fleet bench-federation bench-catchup bench-gossip bench-reactor bench-chaos bench-liveness bench-churn bench-device-verify bench-slo-overhead bench-profile-overhead bench-regress fleet-smoke federation-smoke catchup-smoke gossip-smoke chaos-smoke liveness-smoke churn-smoke metrics-smoke trace-smoke federation-scrape-smoke slo-overhead-smoke profile-overhead-smoke profile-smoke smoke obs-smoke
 
 dev-install:
 	python -m pip install -e '.[dev]'
@@ -190,6 +190,31 @@ bench-slo-overhead:
 # CI short run of the same A/B at tiny shapes.
 slo-overhead-smoke:
 	JAX_PLATFORMS=cpu python bench.py slo-overhead --smoke
+
+# Always-on continuous-profiler cost: paired interleaved A/B (sampler
+# enabled vs parked, thread alive in both arms) on the same
+# decision-heavy workload; the verdict holds the median overhead under
+# the 2% acceptance bar, noise-aware.
+bench-profile-overhead:
+	JAX_PLATFORMS=cpu python bench.py profile-overhead
+
+# CI short run of the same A/B at tiny shapes.
+profile-overhead-smoke:
+	JAX_PLATFORMS=cpu python bench.py profile-overhead --smoke
+
+# End-to-end continuous-profiling check: the gossip smoke with the
+# always-on sampler armed via the env opt-in — every peer serves
+# OP_PROFILE, the bench merges the frames via merge_profile_states and
+# asserts the stage shares in-run (known names, sum <= 1.0).
+profile-smoke:
+	HASHGRAPH_TPU_PROFILE=1 JAX_PLATFORMS=cpu python bench.py gossip --smoke
+
+# Perf-regression sentry: reconstruct the BENCH_*.json trajectory and
+# issue noise-aware verdicts — exit 1 on a confident regression; drops
+# the recorded spreads cannot distinguish from noise stay advisory.
+# (`python bench.py regress` emits the same verdict as a bench line.)
+bench-regress:
+	python tools/bench_regress.py
 
 # Aggregate observability smoke: single-process scrape + trace paths.
 smoke: metrics-smoke trace-smoke

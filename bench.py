@@ -3069,6 +3069,7 @@ def run_gossip(
     controls: list[float] = []
     final_stages = None
     slo_frames: list = []
+    profile_frames: list = []
     convergence = None
     try:
         if not reactor_only:
@@ -3094,6 +3095,19 @@ def run_gossip(
             # SLO state rides home with the bench (the peers decided the
             # sessions, so THEIR SloEngines hold the latency windows).
             slo_frames = [client.metrics_pull() for client in clients]
+            # Continuous-profiling readout (round 20): when the
+            # always-on sampler is armed (HASHGRAPH_TPU_PROFILE=1 — the
+            # profile-smoke CI leg) pull one OP_PROFILE attribution
+            # frame per peer. Old peers answer UNKNOWN_OPCODE and the
+            # client returns None — filtered, not fatal.
+            from hashgraph_tpu.obs.profiler import profiler_enabled
+
+            if profiler_enabled():
+                profile_frames = [
+                    frame
+                    for frame in (client.profile() for client in clients)
+                    if frame is not None
+                ]
 
         # Smoke convergence phase: sampled fanout misses peers on
         # purpose; ONE anti-entropy round (same logical now) repairs
@@ -3254,6 +3268,34 @@ def run_gossip(
                 round(totals.get("apply_rows", 0.0) / dispatches, 2)
                 if dispatches else 0.0
             ),
+        }
+    if profile_frames:
+        # Fleet attribution via the ONE merge (rollup discipline), held
+        # to its contract in-bench: only known stage names, and shares
+        # that sum to a probability mass — a broken denominator fails
+        # the profile-smoke CI leg here, not in a dashboard later.
+        from hashgraph_tpu.obs.attribution import STAGE_KEYS
+        from hashgraph_tpu.parallel.rollup import merge_profile_states
+
+        merged_profile = merge_profile_states(profile_frames)
+        shares = {
+            key: stage["share"]
+            for key, stage in merged_profile["stages"].items()
+        }
+        assert set(shares) == set(STAGE_KEYS), shares
+        assert sum(shares.values()) <= 1.0 + 1e-6, shares
+        samples = merged_profile["samples"]
+        detail["profile"] = {
+            "hosts": sorted(merged_profile["hosts"]),
+            "stage_shares": shares,
+            "busy_seconds": merged_profile["busy_seconds"],
+            "votes_per_dispatch": (
+                merged_profile["device"]["votes_per_dispatch"]
+            ),
+            "samples": samples["total"],
+            "samples_dropped": samples["dropped"],
+            "sample_roles": samples["roles"],
+            "profiler_overhead_s": samples["overhead_seconds"],
         }
     if reactor_block is not None:
         detail["reactor_ab"] = reactor_block
@@ -4325,6 +4367,155 @@ def run_slo_overhead(
     }
 
 
+def run_profile_overhead(
+    p_count: int = 192,
+    v_count: int = 32,
+    reps: int = 5,
+    smoke: bool = False,
+) -> dict:
+    """Always-on stack-sampling cost: paired A/B of the same
+    decision-heavy workload with the continuous profiler sampling vs
+    parked — the round-20 analogue of ``run_slo_overhead``.
+
+    The profiler THREAD stays alive in both arms (that is how it ships:
+    started once at server start, never joined per-request); only
+    ``enabled`` toggles, so the A/B isolates exactly the cost the kill
+    switch can remove — ``sys._current_frames()`` walks plus aggregate
+    upkeep at the adaptive rate. Arms interleave on-off-on-off in one
+    window so drift hits both; the verdict asserts the median overhead
+    stays under the 2% acceptance bar, noise-aware (a gap smaller than
+    the rep spread is reported, not failed on).
+
+    ``smoke`` (CI): tiny shapes, 3 paired reps.
+    """
+    from hashgraph_tpu import (
+        CreateProposalRequest,
+        ScopeConfigBuilder,
+        StubConsensusSigner,
+        build_vote,
+    )
+    from hashgraph_tpu.engine import TpuConsensusEngine
+    from hashgraph_tpu.obs import default_profiler
+
+    if smoke:
+        p_count, v_count, reps = 48, 16, 3
+    now = 1_700_000_000
+    total_votes = p_count * v_count
+    scope_cfg = ScopeConfigBuilder().build()
+    signers = [StubConsensusSigner(bytes([k + 1]) * 20) for k in range(v_count)]
+    engine = TpuConsensusEngine(
+        StubConsensusSigner(b"\x0a" * 20),
+        capacity=p_count + 8,
+        voter_capacity=v_count + 2,
+    )
+
+    def run_arm(tag: str) -> float:
+        batch: "list[tuple[str, object]]" = []
+        scopes = []
+        for p in range(p_count):
+            scope = f"{tag}-p{p}"
+            engine.set_scope_config(scope, scope_cfg)
+            request = CreateProposalRequest(
+                f"p{p}", b"payload", b"o", v_count, 3_600, True
+            )
+            pid = engine.create_proposal(scope, request, now).proposal_id
+            proposal = engine.get_proposal(scope, pid)
+            for signer in signers:
+                vote = build_vote(proposal, True, signer, now + 1)
+                proposal.votes.append(vote)
+                batch.append((scope, vote))
+            scopes.append((scope, pid))
+        t0 = time.perf_counter()
+        engine.ingest_votes(batch, now + 1)
+        wall = time.perf_counter() - t0
+        for scope, pid in scopes:
+            assert engine.get_consensus_result(scope, pid) is True, scope
+        engine.delete_scopes([scope for scope, _pid in scopes])
+        return wall
+
+    was_running = default_profiler.running
+    was_enabled = default_profiler.enabled
+    default_profiler.reset()
+    default_profiler.enabled = True
+    default_profiler.start()
+
+    # Untimed warmup pair compiles at these shapes before either arm.
+    run_arm("on")
+    default_profiler.enabled = False
+    run_arm("off")
+
+    on_rates: list[float] = []
+    off_rates: list[float] = []
+    try:
+        for _rep in range(reps):
+            default_profiler.enabled = True
+            on_rates.append(total_votes / run_arm("on"))
+            default_profiler.enabled = False
+            off_rates.append(total_votes / run_arm("off"))
+    finally:
+        default_profiler.enabled = was_enabled
+        if not was_running:
+            default_profiler.stop()
+
+    med_on = sorted(on_rates)[len(on_rates) // 2]
+    med_off = sorted(off_rates)[len(off_rates) // 2]
+    overhead_pct = round(100.0 * (med_off - med_on) / med_off, 2)
+    max_spread = max(spread_pct(on_rates), spread_pct(off_rates))
+    within_noise = bool(abs(overhead_pct) <= max_spread)
+    snap = default_profiler.snapshot()
+    verdict = {
+        "pass": bool(overhead_pct < 2.0 or within_noise),
+        "criterion": (
+            "median profiler-on throughput within 2% of profiler-off, "
+            "or the gap is inside the rep spread (noise)"
+        ),
+        "overhead_pct": overhead_pct,
+        "within_noise": within_noise,
+        "spread_pct": {
+            "profiler_on": spread_pct(on_rates),
+            "profiler_off": spread_pct(off_rates),
+        },
+    }
+    return {
+        "metric": "profiler_overhead_pct",
+        "value": overhead_pct,
+        "unit": "%",
+        "detail": {
+            "proposals": p_count,
+            "votes_per_proposal": v_count,
+            "reps": reps,
+            "profiler_on_votes_per_sec": [round(r, 1) for r in on_rates],
+            "profiler_off_votes_per_sec": [round(r, 1) for r in off_rates],
+            "median_on": round(med_on, 1),
+            "median_off": round(med_off, 1),
+            "samples": snap["samples"],
+            "sample_roles": snap["roles"],
+            "rate_hz": snap["rate_hz"],
+            "self_measured_overhead_s": snap["overhead_seconds"],
+            "verdict": verdict,
+            "smoke": smoke,
+        },
+    }
+
+
+def run_regress() -> dict:
+    """Perf-regression sentry over the checked-in BENCH_*.json
+    trajectory (``tools/bench_regress.py`` as a bench runner, so the
+    verdict lands in the same artifact stream it audits). Host-only: no
+    engine, no device — it reads the corpus next to this file."""
+    import pathlib
+
+    from tools.bench_regress import build_verdict
+
+    verdict = build_verdict(pathlib.Path(__file__).resolve().parent)
+    return {
+        "metric": "bench_regressions",
+        "value": len(verdict["regressions"]),
+        "unit": "regressions",
+        "detail": verdict,
+    }
+
+
 def run_default() -> dict:
     """The driver-visible sweep: engine-level config 3 as the headline,
     every other BASELINE shape in ``detail`` (one JSON line total).
@@ -4500,7 +4691,7 @@ if __name__ == "__main__":
         if no_compile_cache:
             return
         if compile_cache is None:
-            if which in ("wal", "crypto"):
+            if which in ("wal", "crypto", "regress"):
                 return  # host-only: nothing to cache
             import jax
 
@@ -4618,6 +4809,9 @@ if __name__ == "__main__":
         "churn": lambda: run_churn(smoke=fleet_smoke),
         "slo-overhead": lambda: run_slo_overhead(smoke=fleet_smoke),
         "slo_overhead": lambda: run_slo_overhead(smoke=fleet_smoke),
+        "profile-overhead": lambda: run_profile_overhead(smoke=fleet_smoke),
+        "profile_overhead": lambda: run_profile_overhead(smoke=fleet_smoke),
+        "regress": run_regress,
         "default": run_default,
     }
     def _registry_snapshot() -> dict:

@@ -119,6 +119,36 @@ REQUIRED_FAMILIES = [
     "hashgraph_slo_alerts_firing",
     "hashgraph_slo_burn_rate",
     "hashgraph_slo_incidents_total",
+    # Wire-path stage attribution: per-stage wall-seconds counters plus
+    # columnar/fallback frame counts — the raw inputs the attribution
+    # report fuses. Eagerly installed at server construction.
+    "hashgraph_bridge_wire_columnar_frames_total",
+    "hashgraph_bridge_wire_fallback_frames_total",
+    "hashgraph_bridge_wire_decode_seconds_total",
+    "hashgraph_bridge_wire_crypto_seconds_total",
+    "hashgraph_bridge_wire_apply_seconds_total",
+    "hashgraph_bridge_wire_device_dispatches_total",
+    "hashgraph_bridge_wire_apply_rows_total",
+    "hashgraph_bridge_shm_rings_attached_total",
+    # Cross-connection apply reactor: windowing/flush counters and the
+    # occupancy / rows-per-dispatch histograms exist from process start
+    # even when the reactor is off (they read 0 — a dashboard must not
+    # see a hole on a serial-lane node).
+    "hashgraph_reactor_windows_total",
+    "hashgraph_reactor_rows_total",
+    "hashgraph_reactor_flush_rows_total",
+    "hashgraph_reactor_flush_bytes_total",
+    "hashgraph_reactor_flush_deadline_total",
+    "hashgraph_reactor_flush_now_change_total",
+    "hashgraph_reactor_flush_forced_total",
+    "hashgraph_reactor_window_occupancy_bucket",
+    "hashgraph_reactor_rows_per_dispatch_bucket",
+    # Continuous profiling plane: sample/drop counters and the sampler's
+    # self-measured overhead seconds — present (at 0) even when the
+    # profiler is parked, so the kill switch never hides the families.
+    "hashgraph_profile_samples_total",
+    "hashgraph_profile_dropped_total",
+    "hashgraph_profile_overhead_seconds_total",
 ]
 
 

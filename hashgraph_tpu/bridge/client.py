@@ -418,6 +418,21 @@ class BridgeClient:
         hosts into one fleet-wide scrape."""
         return json.loads(self._call(P.OP_METRICS_PULL).blob().decode("utf-8"))
 
+    def profile(self) -> "dict | None":
+        """Wall-clock attribution frame (``OP_PROFILE``, server-wide):
+        ``{"host": <label>, "profile": <attribution report>}`` — stage
+        busy shares, reactor dispatch counters, and the continuous
+        profiler's sampled per-role stack summary. Host-labelled so
+        ``parallel.rollup.merge_profile_states`` can federate frames.
+        Returns None against an old peer (STATUS_UNKNOWN_OPCODE — the
+        HELLO interop discipline: absence of the plane, not a fault)."""
+        try:
+            return json.loads(self._call(P.OP_PROFILE).blob().decode("utf-8"))
+        except BridgeError as exc:
+            if exc.status == P.STATUS_UNKNOWN_OPCODE:
+                return None
+            raise
+
     def state_fingerprint(self, peer: int) -> str:
         """The peer engine's order-insensitive content digest
         (``OP_STATE_FINGERPRINT``; see ``sync.state_fingerprint``) — two

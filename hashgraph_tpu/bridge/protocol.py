@@ -189,6 +189,15 @@ OP_FLEET_TALLY = 23
 # /metrics + /slo view with per-host labels.
 OP_METRICS_PULL = 24
 
+# Server-wide (no peer_id) -> JSON blob {"host": <label>, "profile":
+# <obs.attribution.attribution_report()>}: the wall-clock attribution
+# readout (per-stage busy shares, reactor dispatch counters, continuous
+# profiler sample summary). Host-labelled like OP_METRICS_PULL so
+# parallel.rollup.merge_profile_states federates frames into one fleet
+# view. Old servers answer STATUS_UNKNOWN_OPCODE — callers treat that
+# as "no profile plane", the HELLO interop discipline.
+OP_PROFILE = 25
+
 # Opcodes that mutate server-side state (plus POLL_EVENTS, whose read is
 # DESTRUCTIVE — it drains the peer's event queue). On a pipelined
 # connection the server executes these in receive order per connection;

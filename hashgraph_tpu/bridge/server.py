@@ -48,6 +48,7 @@ from ..obs import (
 )
 from ..obs import registry as default_registry
 from ..obs import slo_engine as default_slo_engine
+from ..obs.profiler import maybe_start_default as maybe_start_profiler
 from ..obs.trace import trace_store, use_context
 from ..parallel.fleet import ShardRecoveringError
 from ..signing import ConsensusSignatureScheme
@@ -544,6 +545,11 @@ class BridgeServer:
         )
         if self._reactor is not None:
             self._reactor.start()
+        # Always-on stack sampling, $HASHGRAPH_TPU_PROFILE=1 opt-in (the
+        # reactor's env-gate pattern): every serving process gets the
+        # continuous-profiling loop without per-embedder wiring. The
+        # process-wide instance is idempotent across servers.
+        maybe_start_profiler()
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
         return self.address
@@ -643,7 +649,12 @@ class BridgeServer:
             except OSError:
                 return  # listener closed
             threading.Thread(
-                target=self._serve_connection, args=(conn,), daemon=True
+                target=self._serve_connection,
+                args=(conn,),
+                # Named so the continuous profiler's role table can
+                # attribute reader-thread samples (obs.profiler).
+                name="bridge-reader",
+                daemon=True,
             ).start()
 
     def _serve_connection(self, conn: socket.socket) -> None:
@@ -1256,6 +1267,20 @@ class BridgeServer:
                 "state": default_registry.export_state(),
                 "slo": default_slo_engine.state(),
             }
+            return P.STATUS_OK, P.blob(json.dumps(payload).encode("utf-8"))
+        if opcode == P.OP_PROFILE:
+            # Server-wide attribution readout (stage busy shares +
+            # sampled stacks), host-labelled like OP_METRICS_PULL so
+            # merge_profile_states can federate frames across hosts.
+            from ..obs.attribution import attribution_report
+
+            label = self.host_label
+            if label is None:
+                try:
+                    label = "%s:%d" % (self._host, self.address[1])
+                except Exception:
+                    label = self._host
+            payload = {"host": label, "profile": attribution_report()}
             return P.STATUS_OK, P.blob(json.dumps(payload).encode("utf-8"))
         if opcode == P.OP_VOTE_BATCH:
             # Multi-peer frame: groups carry their own peer ids.

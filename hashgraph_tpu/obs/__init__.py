@@ -87,6 +87,17 @@ hashgraph_slo_alerts_firing                     gauge      SLO engine (objective
 hashgraph_slo_decision_p99_seconds (+ {scope=...}/{shard=...})  gauge  SLO engine (fast-window p99)
 hashgraph_slo_burn_rate (+ {scope=...,window=...})  gauge   SLO engine (max fast-window burn rate)
 hashgraph_slo_incidents_total                   counter    incident capture (dumps written)
+hashgraph_bridge_wire_{columnar,fallback}_frames_total  counter  wire ingest (frames per decode path)
+hashgraph_bridge_wire_{decode,crypto,apply}_seconds_total  counter  wire ingest (per-stage busy seconds)
+hashgraph_bridge_wire_device_dispatches_total   counter    wire ingest (fused device calls issued)
+hashgraph_bridge_wire_apply_rows_total          counter    wire ingest (vote rows riding dispatches)
+hashgraph_bridge_shm_rings_attached_total       counter    bridge shm lane attachments
+hashgraph_reactor_{windows,rows}_total          counter    apply reactor (windows flushed / rows ridden)
+hashgraph_reactor_flush_{rows,bytes,deadline,now_change,forced}_total  counter  apply reactor flush reasons
+hashgraph_reactor_window_occupancy              histogram  apply reactor (frames merged per window)
+hashgraph_reactor_rows_per_dispatch             histogram  apply reactor (rows per fused dispatch)
+hashgraph_profile_{samples,dropped}_total       counter    continuous profiler (stacks sampled / cap drops)
+hashgraph_profile_overhead_seconds_total        counter    continuous profiler (self-measured sampling cost)
 ==============================================  =========  ==================
 
 The table above is machine-readable: :func:`documented_families` parses it
@@ -124,6 +135,16 @@ from .health import (
     PeerScorecard,
 )
 from .http import MetricsSidecar
+from .attribution import attribution_report, report_from_stage_totals
+from .profiler import (
+    PROFILE_DROPPED_TOTAL,
+    PROFILE_OVERHEAD_SECONDS_TOTAL,
+    PROFILE_SAMPLES_TOTAL,
+    ContinuousProfiler,
+    parse_collapsed,
+    profiler_enabled,
+    thread_role,
+)
 from .registry import (
     DEFAULT_SIZE_BUCKETS,
     DEFAULT_TIME_BUCKETS,
@@ -415,6 +436,9 @@ def _install_well_known(reg: MetricsRegistry) -> None:
         SLO_BREACHES_TOTAL,
         SLO_ALERTS_TOTAL,
         SLO_INCIDENTS_TOTAL,
+        PROFILE_SAMPLES_TOTAL,
+        PROFILE_DROPPED_TOTAL,
+        PROFILE_OVERHEAD_SECONDS_TOTAL,
     ):
         reg.counter(name)
     # SLO gauges with registered providers come from the SloEngine bound
@@ -492,6 +516,13 @@ slo_engine = SloEngine(
     registry,
     capture=IncidentCapture(counter=registry.counter(SLO_INCIDENTS_TOTAL)),
 )
+
+# Process-wide continuous profiler (mirrors ``registry``'s role): dormant
+# until something starts it — ``BridgeServer.start()`` under the
+# ``$HASHGRAPH_TPU_PROFILE=1`` opt-in (profiler.maybe_start_default), or
+# an embedder directly. Its sample summary rides every attribution
+# report (``/profile``, ``OP_PROFILE``, incident bundles).
+default_profiler = ContinuousProfiler(registry)
 
 
 def documented_families() -> list[str]:
@@ -642,6 +673,7 @@ def observed_span(tracer, name: str, histogram: Histogram, **attrs):
 
 __all__ = [
     "AlertRule",
+    "ContinuousProfiler",
     "Counter",
     "EvidenceRecord",
     "FlightRecorder",
@@ -663,7 +695,9 @@ __all__ = [
     "TraceStore",
     "WindowedHistogram",
     "attach_trace",
+    "attribution_report",
     "current_context",
+    "default_profiler",
     "documented_families",
     "extract_trace",
     "flight_recorder",
@@ -672,9 +706,13 @@ __all__ = [
     "log_buckets",
     "merge_traces",
     "observed_span",
+    "parse_collapsed",
     "phi_from_deviation",
+    "profiler_enabled",
     "registry",
+    "report_from_stage_totals",
     "slo_engine",
+    "thread_role",
     "trace_store",
     "use_context",
 ]

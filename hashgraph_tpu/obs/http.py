@@ -1,6 +1,7 @@
 """Stdlib-threaded HTTP sidecar: ``/metrics`` (Prometheus text format),
-``/healthz`` (JSON liveness), and ``/slo`` (machine-readable SLO /
-burn-rate alert state) without any dependency beyond ``http.server``.
+``/healthz`` (JSON liveness), ``/slo`` (machine-readable SLO /
+burn-rate alert state), and ``/profile`` (wall-clock attribution +
+sampled-stack summary) without any dependency beyond ``http.server``.
 
 The sidecar is deliberately tiny: scrapes are infrequent (seconds apart)
 and the render is a single registry walk, so a ThreadingHTTPServer on a
@@ -27,7 +28,11 @@ class MetricsSidecar:
     callable (federation) to serve fleet-wide SLO state instead.
     ``render_fn`` (optional) overrides the ``/metrics`` text entirely —
     the federation's merged-scrape hook (one scrape, every host's
-    families labelled ``host="..."`` plus fleet totals)."""
+    families labelled ``host="..."`` plus fleet totals). ``profile_fn``
+    (optional) returns the JSON body for ``/profile`` — by default the
+    process's :func:`~hashgraph_tpu.obs.attribution.attribution_report`;
+    pass a merged-view callable (federation) to serve the fleet rollup
+    instead."""
 
     def __init__(
         self,
@@ -37,6 +42,7 @@ class MetricsSidecar:
         health_fn=None,
         slo_fn=None,
         render_fn=None,
+        profile_fn=None,
     ):
         self._registry = registry
         self._host = host
@@ -52,6 +58,14 @@ class MetricsSidecar:
                 return slo_engine.state()
 
         self._slo_fn = slo_fn
+        if profile_fn is None:
+            # Same late-import discipline as slo_fn.
+            def profile_fn():
+                from .attribution import attribution_report
+
+                return attribution_report()
+
+        self._profile_fn = profile_fn
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -66,6 +80,7 @@ class MetricsSidecar:
         health_fn = self._health_fn
         slo_fn = self._slo_fn
         render_fn = self._render_fn
+        profile_fn = self._profile_fn
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib naming)
@@ -84,6 +99,16 @@ class MetricsSidecar:
                 elif self.path.split("?", 1)[0] == "/slo":
                     try:
                         payload = slo_fn()
+                    except Exception as exc:
+                        payload = {"error": repr(exc)}
+                    self._reply(
+                        200,
+                        "application/json",
+                        json.dumps(payload).encode("utf-8"),
+                    )
+                elif self.path.split("?", 1)[0] == "/profile":
+                    try:
+                        payload = profile_fn()
                     except Exception as exc:
                         payload = {"error": repr(exc)}
                     self._reply(

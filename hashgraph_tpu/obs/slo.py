@@ -171,7 +171,12 @@ class IncidentCapture:
       (explicit-path dump, so the fault-dump throttle is not consumed);
     - ``trace.json`` — ``trace_store`` spans as a Chrome trace-event
       document (Perfetto / chrome://tracing open it directly), filtered
-      to the breaching trace id when its spans are still in the store.
+      to the breaching trace id when its spans are still in the store;
+    - ``profile.json`` — the wall-clock attribution report
+      (:func:`~hashgraph_tpu.obs.attribution.attribution_report`):
+      per-stage busy shares plus the continuous profiler's sampled
+      per-role stack counts — *what the process was doing* when the
+      objective broke, not just the breaching trace.
 
     Bounded two ways: newest ``max_incidents`` directories are kept
     (oldest pruned), and a per-scope ``cooldown_s`` collapses a breach
@@ -245,6 +250,15 @@ class IncidentCapture:
             doc.setdefault("otherData", {})["incident"] = reason
             with open(os.path.join(path, "trace.json"), "w") as fh:
                 json.dump(doc, fh)
+            try:
+                # Additive evidence: a failing attribution read must not
+                # cost the flight/trace dumps already on disk.
+                from .attribution import attribution_report
+
+                with open(os.path.join(path, "profile.json"), "w") as fh:
+                    json.dump(attribution_report(), fh, indent=2)
+            except Exception:
+                pass
             meta = {
                 "reason": reason,
                 "scope": key if scope is not None else None,

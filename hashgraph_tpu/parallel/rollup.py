@@ -175,3 +175,70 @@ def merge_slo_states(frames) -> dict:
         "incidents": incidents,
         "global": {"count": count, "worst_p99": worst_p99},
     }
+
+
+def merge_profile_states(frames) -> dict:
+    """Fleet attribution view from ``OP_PROFILE`` frames (``{"host":
+    label, "profile": <obs.attribution.attribution_report()>}``):
+    per-host reports keyed by host label, plus the fleet rollup —
+    per-stage busy seconds summed across hosts with shares recomputed
+    over the fleet-wide denominator, device dispatch/row totals (and
+    the fleet-wide amortization factor), and sample/role totals from
+    every host's continuous profiler. The same discipline as
+    :func:`merge_metric_states`: ONE merge, every fleet surface (the
+    federation sidecar's ``/profile``, bench reports) goes through it."""
+    from ..obs.attribution import STAGE_KEYS
+
+    hosts: dict = {}
+    seconds = {key: 0.0 for key in STAGE_KEYS}
+    dispatches = 0.0
+    apply_rows = 0.0
+    wal_fsyncs = 0
+    samples_total = 0
+    samples_dropped = 0
+    overhead_s = 0.0
+    roles: dict = {}
+    for frame in frames:
+        host = str(frame.get("host", "unknown"))
+        profile = frame.get("profile") or {}
+        hosts[host] = profile
+        for key, stage in (profile.get("stages") or {}).items():
+            if key in seconds:
+                seconds[key] += float(stage.get("seconds", 0.0))
+        device = profile.get("device") or {}
+        dispatches += float(device.get("dispatches", 0.0))
+        apply_rows += float(device.get("apply_rows", 0.0))
+        wal_fsyncs += int((profile.get("wal") or {}).get("fsyncs", 0))
+        samples = profile.get("samples") or {}
+        samples_total += int(samples.get("total", 0))
+        samples_dropped += int(samples.get("dropped", 0))
+        overhead_s += float(samples.get("overhead_seconds", 0.0))
+        for role, n in (samples.get("roles") or {}).items():
+            roles[role] = roles.get(role, 0) + int(n)
+    busy = sum(seconds.values())
+    return {
+        "schema": "hashgraph.attribution.v1",
+        "hosts": hosts,
+        "busy_seconds": round(busy, 6),
+        "stages": {
+            key: {
+                "seconds": round(seconds[key], 6),
+                "share": round(seconds[key] / busy, 4) if busy else 0.0,
+            }
+            for key in STAGE_KEYS
+        },
+        "device": {
+            "dispatches": dispatches,
+            "apply_rows": apply_rows,
+            "votes_per_dispatch": (
+                round(apply_rows / dispatches, 2) if dispatches else 0.0
+            ),
+        },
+        "wal": {"fsyncs": wal_fsyncs},
+        "samples": {
+            "total": samples_total,
+            "dropped": samples_dropped,
+            "overhead_seconds": round(overhead_s, 6),
+            "roles": dict(sorted(roles.items())),
+        },
+    }
